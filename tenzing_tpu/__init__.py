@@ -1,0 +1,36 @@
+"""tenzing_tpu — a TPU-native framework for searching over execution schedules.
+
+A TPU+ICI program (halo exchange, distributed SpMV, ...) is modeled as a DAG of
+operations.  Remaining implementation freedom — the total order of operations, the
+assignment of device ops to execution *lanes*, the insertion of synchronization ops
+that make a given order legal, and choices among implementation variants — is a
+sequential decision problem searched by exhaustive DFS (`tenzing_tpu.solve.dfs`) and
+Monte-Carlo tree search (`tenzing_tpu.solve.mcts`).  Every candidate schedule is
+lowered to a single XLA program whose dependency structure *is* the schedule
+(token-threaded lanes, see `tenzing_tpu.runtime.executor`) and empirically
+benchmarked on the device.
+
+Capability parity target: sandialabs/tenzing (see SURVEY.md).  This is a new
+TPU-first design, not a port: CUDA streams -> virtual lanes realized as
+optimization-barrier token chains inside one compiled XLA program; cudaEvent ->
+cross-lane token edges; MPI Isend/Irecv -> ICI collectives (`lax.ppermute`) under
+`shard_map`; MPI control plane -> host-side process coordination.
+"""
+
+__version__ = "0.1.0"
+
+from tenzing_tpu.core.operation import (  # noqa: F401
+    OpBase,
+    BoundOp,
+    ChoiceOp,
+    CompoundOp,
+    CpuOp,
+    DeviceOp,
+    BoundDeviceOp,
+    Start,
+    Finish,
+    NoOp,
+)
+from tenzing_tpu.core.graph import Graph  # noqa: F401
+from tenzing_tpu.core.sequence import Sequence  # noqa: F401
+from tenzing_tpu.core.resources import Lane, Event, Bijection, Equivalence  # noqa: F401

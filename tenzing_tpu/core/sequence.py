@@ -1,0 +1,120 @@
+"""Ordered (partial or total) schedules.
+
+Parity target: reference ``include/tenzing/sequence.hpp`` / ``src/sequence.cpp``:
+a vector of ops with bound/unbound matching (sequence.hpp:48-75), smallest-free
+virtual event allocation (``new_unique_event``, sequence.hpp:77-93), sequence
+equivalence under lane/event bijection (sequence.cpp:21-86), and schedule
+broadcast across hosts (``mpi_bcast``, sequence.cpp:88-125 — here realized by the
+control plane in tenzing_tpu.parallel.control_plane, serializing to JSON and
+re-materializing ops against the local graph).
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Iterable, Iterator, List, Optional, TypeVar
+
+from tenzing_tpu.core.operation import BoundDeviceOp, OpBase, unbound
+from tenzing_tpu.core.resources import Equivalence, Event
+
+OpT = TypeVar("OpT", bound=OpBase)
+
+
+class Sequence(Generic[OpT]):
+    """An ordered list of ops (reference Sequence<OpType>)."""
+
+    def __init__(self, ops: Optional[Iterable[OpT]] = None):
+        self._ops: List[OpT] = list(ops) if ops is not None else []
+
+    # -- list protocol ----------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._ops)
+
+    def __iter__(self) -> Iterator[OpT]:
+        return iter(self._ops)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return Sequence(self._ops[i])
+        return self._ops[i]
+
+    def push_back(self, op: OpT) -> None:
+        self._ops.append(op)
+
+    def vector(self) -> List[OpT]:
+        return list(self._ops)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Sequence) and self._ops == other._ops
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Sequence([{', '.join(op.desc() for op in self._ops)}])"
+
+    # -- bound/unbound matching (reference sequence.hpp:48-75) -------------
+    def contains(self, op: OpBase) -> bool:
+        return any(o == op for o in self._ops)
+
+    def contains_unbound(self, op: OpBase) -> bool:
+        """True if the sequence contains ``op`` or a lane-bound version of it
+        (reference contains_unbound; with resource-insensitive identity this is
+        plain equality)."""
+        target = unbound(op)
+        return any(unbound(o) == target for o in self._ops)
+
+    def find_unbound(self, op: OpBase) -> Optional[OpBase]:
+        """The sequence entry matching ``op`` modulo lane binding, or None
+        (reference find_unbound, sequence.cpp:140-167)."""
+        target = unbound(op)
+        for o in self._ops:
+            if unbound(o) == target:
+                return o
+        return None
+
+    # -- event allocation (reference sequence.hpp:77-93) -------------------
+    def new_unique_event(self) -> Event:
+        """Smallest virtual Event id not used by any op in the sequence."""
+        used = set()
+        for op in self._ops:
+            events = getattr(op, "events", None)
+            if events is not None:
+                used.update(e.id for e in events())
+        i = 0
+        while i in used:
+            i += 1
+        return Event(i)
+
+    def desc(self, delim: str = ", ") -> str:
+        return delim.join(op.desc() for op in self._ops)
+
+
+def get_equivalence(a: Sequence, b: Sequence, base: Optional[Equivalence] = None) -> Equivalence:
+    """Equivalence of two sequences up to a consistent renaming of lanes and
+    events (reference sequence.cpp:21-86): ops must match pairwise in order by
+    resource-insensitive identity, and their lane/event uses must admit mutually
+    consistent bijections (extending ``base`` when given)."""
+    if len(a) != len(b):
+        return Equivalence.falsy()
+    e = base.copy() if base is not None else Equivalence()
+    if not e:
+        return Equivalence.falsy()
+    for x, y in zip(a, b):
+        if x.eq_key() != y.eq_key():
+            return Equivalence.falsy()
+        xl = x.lanes() if hasattr(x, "lanes") else []
+        yl = y.lanes() if hasattr(y, "lanes") else []
+        if len(xl) != len(yl):
+            return Equivalence.falsy()
+        for la, lb in zip(xl, yl):
+            if not e.check_or_insert_lane(la, lb):
+                return Equivalence.falsy()
+        xe = x.events() if hasattr(x, "events") else []
+        ye = y.events() if hasattr(y, "events") else []
+        if len(xe) != len(ye):
+            return Equivalence.falsy()
+        for ea, eb in zip(xe, ye):
+            if not e.check_or_insert_event(ea, eb):
+                return Equivalence.falsy()
+    return e
+
+
+def is_equivalent(a: Sequence, b: Sequence) -> bool:
+    return bool(get_equivalence(a, b))

@@ -1,0 +1,10 @@
+"""Test environment: force CPU with 8 virtual devices so multi-chip sharding tests
+run anywhere (SURVEY.md §4: the reference's CI runs the CPU-tagged subset only;
+device tests are opt-in).  Must run before jax is imported anywhere."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

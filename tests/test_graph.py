@@ -1,0 +1,137 @@
+"""Graph construction/surgery (reference src/graph.cpp:422-501 inline tests)."""
+
+import pytest
+
+from tenzing_tpu.core.graph import Graph, get_equivalence, is_equivalent_lane_mapping
+from tenzing_tpu.core.operation import (
+    BoundDeviceOp,
+    CompoundOp,
+    DeviceOp,
+    NoOp,
+)
+from tenzing_tpu.core.resources import Lane
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def test_empty_graph():
+    g = Graph()
+    assert g.vertex_size() == 2  # start, finish
+    assert g.start() in g and g.finish() in g
+
+
+def test_then_chain():
+    g = Graph()
+    a, b = NoOp("a"), NoOp("b")
+    g.start_then(a)
+    g.then(a, b)
+    g.then_finish(b)
+    assert g.vertex_size() == 4
+    assert g.succs(a) == [b]
+    assert g.preds(b) == [a]
+    assert g.frontier([g.start()]) == [a]
+    assert g.frontier([g.start(), a]) == [b]
+    assert g.frontier([g.start(), a, b]) == [g.finish()]
+
+
+def test_clone_membership():
+    g = Graph()
+    a = NoOp("a")
+    g.start_then(a)
+    g.then_finish(a)
+    c = g.clone()
+    assert c.vertex_size() == g.vertex_size()
+    assert a in c
+    c.then(a, NoOp("x"))
+    assert NoOp("x") not in g  # clone is independent
+
+
+def test_clone_but_replace_lane_binding():
+    g = Graph()
+    k = KOp("k")
+    g.start_then(k)
+    g.then_finish(k)
+    g2 = g.clone_but_replace(k.bind(Lane(1)), k)
+    assert g2.vertex_size() == 3
+    # identity is preserved; the stored vertex object is now bound
+    v = [x for x in g2.vertices() if x == k][0]
+    assert isinstance(v, BoundDeviceOp) and v.lane() == Lane(1)
+    # original untouched
+    v0 = [x for x in g.vertices() if x == k][0]
+    assert not isinstance(v0, BoundDeviceOp)
+
+
+class TwoOpCompound(CompoundOp):
+    def __init__(self, name):
+        super().__init__(name)
+        self._g = Graph()
+        self._a, self._b = NoOp(name + ".a"), NoOp(name + ".b")
+        self._g.start_then(self._a)
+        self._g.then(self._a, self._b)
+        self._g.then_finish(self._b)
+
+    def graph(self):
+        return self._g
+
+
+def test_clone_but_expand():
+    g = Graph()
+    comp = TwoOpCompound("c")
+    pre, post = NoOp("pre"), NoOp("post")
+    g.start_then(pre)
+    g.then(pre, comp)
+    g.then(comp, post)
+    g.then_finish(post)
+    g2 = g.clone_but_expand(comp)
+    assert comp not in g2
+    assert NoOp("c.a") in g2 and NoOp("c.b") in g2
+    # pre -> c.a -> c.b -> post
+    assert g2.succs(pre) == [NoOp("c.a")]
+    assert g2.succs(NoOp("c.a")) == [NoOp("c.b")]
+    assert g2.succs(NoOp("c.b")) == [post]
+    # start/finish untouched
+    assert g2.vertex_size() == 6
+
+
+def test_graph_equivalence_lane_bijection():
+    def make(l0, l1):
+        g = Graph()
+        a, b = KOp("a"), KOp("b")
+        g.start_then(a.bind(l0))
+        g.start_then(b.bind(l1))
+        g.then_finish(a.bind(l0))
+        g.then_finish(b.bind(l1))
+        return g
+
+    # consistent renaming 0<->1 is equivalent
+    assert is_equivalent_lane_mapping(make(Lane(0), Lane(1)), make(Lane(1), Lane(0)))
+    assert is_equivalent_lane_mapping(make(Lane(0), Lane(0)), make(Lane(1), Lane(1)))
+    # same-lane vs distinct-lane is NOT
+    assert not is_equivalent_lane_mapping(make(Lane(0), Lane(0)), make(Lane(0), Lane(1)))
+
+
+def test_use_lanes_enumeration():
+    g = Graph()
+    a, b = KOp("a"), KOp("b")
+    g.start_then(a)
+    g.then(a, b)
+    g.then_finish(b)
+    gs = g.use_lanes([Lane(0), Lane(1)])
+    assert len(gs) == 4
+    uniq = []
+    for cand in gs:
+        if not any(is_equivalent_lane_mapping(cand, u) for u in uniq):
+            uniq.append(cand)
+    # {same lane, different lanes} up to renaming
+    assert len(uniq) == 2
+
+
+def test_graphviz_dump():
+    g = Graph()
+    g.start_then(NoOp("a"))
+    g.then_finish(NoOp("a"))
+    dot = g.dump_graphviz()
+    assert "digraph" in dot and '"a"' in dot

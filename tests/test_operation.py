@@ -1,0 +1,73 @@
+"""Op identity semantics (reference src/operation.cpp:87-100 inline tests)."""
+
+from tenzing_tpu.core.operation import (
+    BoundDeviceOp,
+    DeviceOp,
+    Finish,
+    NoOp,
+    Start,
+    keep_uniques,
+    make_lane_variations,
+    unbound,
+)
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.sync_ops import EventRecord, EventSync, LaneSync, WaitEvent
+
+
+class KOp(DeviceOp):
+    """Minimal fake device op (reference test_gpu_graph.cu:12-28 KernelOp)."""
+
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def test_name_equality():
+    assert NoOp("a") == NoOp("a")
+    assert NoOp("a") != NoOp("b")
+    assert Start() == Start()
+    assert Finish() == Finish()
+    assert Start() != Finish()
+
+
+def test_bound_equals_unbound():
+    op = KOp("k")
+    b0 = op.bind(Lane(0))
+    b1 = op.bind(Lane(1))
+    # lane-insensitive identity (reference operation.hpp:20-32)
+    assert b0 == op
+    assert b0 == b1
+    assert hash(b0) == hash(op)
+    assert unbound(b0) is op
+    assert b0.lane() == Lane(0) and b1.lane() == Lane(1)
+
+
+def test_sync_ops_compare_kind_only():
+    # reference ops_cuda.hpp:15-20 dedup invariant
+    assert EventRecord(Lane(0), Event(0)) == EventRecord(Lane(1), Event(5))
+    assert WaitEvent(Lane(0), Event(0)) == WaitEvent(Lane(2), Event(9))
+    assert EventRecord(Lane(0), Event(0)) != WaitEvent(Lane(0), Event(0))
+    assert EventSync(Event(1)) != LaneSync(Lane(1))
+
+
+def test_lane_variations():
+    op = KOp("k")
+    lanes = [Lane(0), Lane(1)]
+    vars = make_lane_variations(op, lanes)
+    assert [v.lane() for v in vars] == lanes
+    # non-device ops pass through
+    n = NoOp("n")
+    assert make_lane_variations(n, lanes) == [n]
+    # rebinding an already-bound op
+    rb = make_lane_variations(op.bind(Lane(1)), lanes)
+    assert [v.lane() for v in rb] == lanes
+
+
+def test_keep_uniques():
+    a, b = NoOp("a"), NoOp("b")
+    assert keep_uniques([a, b, NoOp("a"), a]) == [a, b]
+
+
+def test_total_order():
+    ops = sorted([NoOp("b"), Finish(), NoOp("a"), Start()])
+    # deterministic, stable total order usable as map keys
+    assert ops == sorted(reversed(ops))

@@ -1,0 +1,72 @@
+"""Sequence semantics (reference src/sequence.cpp:169-175 and sequence.cpp:21-86)."""
+
+from tenzing_tpu.core.operation import DeviceOp, NoOp, Start
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.sequence import Sequence, get_equivalence, is_equivalent
+from tenzing_tpu.core.sync_ops import EventRecord, EventSync, WaitEvent
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def test_empty_sequence():
+    s = Sequence()
+    assert len(s) == 0
+    assert not s.contains(NoOp("a"))
+
+
+def test_unbound_matching():
+    k = KOp("k")
+    s = Sequence([Start(), k.bind(Lane(1))])
+    assert s.contains_unbound(k)
+    found = s.find_unbound(k)
+    assert found is not None and found.lane() == Lane(1)
+    assert s.find_unbound(KOp("other")) is None
+
+
+def test_new_unique_event():
+    s = Sequence([Start()])
+    assert s.new_unique_event() == Event(0)
+    s.push_back(EventRecord(Lane(0), Event(0)))
+    assert s.new_unique_event() == Event(1)
+    s.push_back(WaitEvent(Lane(1), Event(2)))
+    assert s.new_unique_event() == Event(1)
+    s.push_back(EventSync(Event(1)))
+    assert s.new_unique_event() == Event(3)
+
+
+def test_equivalence_lane_event_bijection():
+    a, b = KOp("a"), KOp("b")
+
+    def seq(l0, l1, e):
+        return Sequence(
+            [
+                Start(),
+                a.bind(l0),
+                EventRecord(l0, e),
+                WaitEvent(l1, e),
+                b.bind(l1),
+            ]
+        )
+
+    s1 = seq(Lane(0), Lane(1), Event(0))
+    s2 = seq(Lane(1), Lane(0), Event(4))
+    assert is_equivalent(s1, s2)
+
+    # inconsistent lane mapping: a on 0 and b on 0 vs a on 0, b on 1
+    s3 = seq(Lane(0), Lane(0), Event(0))
+    assert not is_equivalent(s1, s3)
+
+    # different op order is not equivalent
+    s4 = Sequence([Start(), b.bind(Lane(1)), a.bind(Lane(0))])
+    assert not is_equivalent(Sequence([Start(), a.bind(Lane(0)), b.bind(Lane(1))]), s4)
+
+
+def test_equivalence_returns_bijection():
+    a = KOp("a")
+    s1 = Sequence([a.bind(Lane(0))])
+    s2 = Sequence([a.bind(Lane(3))])
+    e = get_equivalence(s1, s2)
+    assert e and e.lanes[Lane(0)] == Lane(3)

@@ -1,0 +1,101 @@
+"""SDP State semantics — NoOp graph (reference test/test_noop_graph.cpp:10-44) and
+device graph (reference test/test_gpu_graph.cu:41-119)."""
+
+import pytest
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import BoundDeviceOp, DeviceOp, NoOp
+from tenzing_tpu.core.resources import Lane
+from tenzing_tpu.core.state import (
+    AssignLane,
+    ExecuteOp,
+    State,
+    get_equivalence,
+)
+
+
+class FakePlatform:
+    def __init__(self, n):
+        self.lanes = [Lane(i) for i in range(n)]
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def test_noop_graph_decisions():
+    g = Graph()
+    op1 = NoOp("op1")
+    g.start_then(op1)
+    g.then_finish(op1)
+    s = State(g)
+    # initial state has Start in the sequence
+    assert s.sequence.contains(g.start())
+    ds = s.get_decisions(FakePlatform(2))
+    assert len(ds) == 1
+    assert isinstance(ds[0], ExecuteOp) and ds[0].op == op1
+    s2 = s.apply(ds[0])
+    assert len(s2.sequence) == 2
+    # then finish
+    ds2 = s2.get_decisions(FakePlatform(2))
+    assert len(ds2) == 1 and ds2[0].op == g.finish()
+    s3 = s2.apply(ds2[0])
+    assert s3.is_terminal()
+
+
+def test_device_graph_lane_assignment():
+    g = Graph()
+    k = KOp("k")
+    g.start_then(k)
+    g.then_finish(k)
+    plat = FakePlatform(2)
+    s = State(g)
+    ds = s.get_decisions(plat)
+    # one AssignLane per lane (reference test_gpu_graph.cu:60-80)
+    assert len(ds) == 2
+    assert all(isinstance(d, AssignLane) for d in ds)
+    assert {d.lane for d in ds} == {Lane(0), Lane(1)}
+    # assigning lane 0 vs lane 1 yields equivalent states (test_gpu_graph.cu:83-93)
+    s0, s1 = s.apply(ds[0]), s.apply(ds[1])
+    assert get_equivalence(s0, s1)
+    # after binding, an execute decision appears
+    ds0 = s0.get_decisions(plat)
+    assert len(ds0) == 1 and isinstance(ds0[0], ExecuteOp)
+    assert isinstance(ds0[0].op, BoundDeviceOp)
+
+
+def test_state_frontier_dedups_equivalent_lane_choices():
+    g = Graph()
+    k = KOp("k")
+    g.start_then(k)
+    g.then_finish(k)
+    s = State(g)
+    succs = s.frontier(FakePlatform(2))
+    # lane0 and lane1 bindings are equivalent -> one survivor (ref defect fixed)
+    assert len(succs) == 1
+    succs_nodedup = s.frontier(FakePlatform(2), dedup=False)
+    assert len(succs_nodedup) == 2
+
+
+def test_full_enumeration_two_independent_noops():
+    g = Graph()
+    a, b = NoOp("a"), NoOp("b")
+    g.start_then(a)
+    g.start_then(b)
+    g.then_finish(a)
+    g.then_finish(b)
+    plat = FakePlatform(1)
+
+    # exhaustive DFS over states: both interleavings reach terminal
+    terminals = []
+    stack = [State(g)]
+    while stack:
+        st = stack.pop()
+        if st.is_terminal():
+            terminals.append(st)
+            continue
+        stack.extend(st.frontier(plat, dedup=False))
+    assert len(terminals) == 2
+    descs = {t.sequence.desc() for t in terminals}
+    assert descs == {"start, a, b, finish", "start, b, a, finish"}

@@ -1,0 +1,130 @@
+"""EventSynchronizer truth table (reference event_synchronizer.hpp:29-242)."""
+
+from tenzing_tpu.core.event_synchronizer import EventSynchronizer
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import DeviceOp, NoOp, Start
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.sync_ops import EventRecord, EventSync, LaneSync, WaitEvent
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def two_op_graph(a, b):
+    g = Graph()
+    g.start_then(a)
+    g.then(a, b)
+    g.then_finish(b)
+    return g
+
+
+def test_host_then_host_free():
+    a, b = NoOp("a"), NoOp("b")
+    g = two_op_graph(a, b)
+    seq = Sequence([g.start(), a])
+    assert EventSynchronizer.is_synced(g, seq, b)
+    assert EventSynchronizer.make_syncs(g, seq, b) == []
+
+
+def test_host_then_device_free():
+    a, k = NoOp("a"), KOp("k").bind(Lane(0))
+    g = two_op_graph(a, k)
+    seq = Sequence([g.start(), a])
+    assert EventSynchronizer.is_synced(g, seq, k)
+
+
+def test_device_then_device_same_lane_free():
+    a, b = KOp("a").bind(Lane(0)), KOp("b").bind(Lane(0))
+    g = two_op_graph(a, b)
+    seq = Sequence([g.start(), a])
+    assert EventSynchronizer.is_synced(g, seq, b)
+
+
+def test_device_then_device_cross_lane_needs_record_then_wait():
+    a, b = KOp("a").bind(Lane(0)), KOp("b").bind(Lane(1))
+    g = two_op_graph(a, b)
+    seq = Sequence([g.start(), a])
+    assert not EventSynchronizer.is_synced(g, seq, b)
+
+    # step 1: a fresh EventRecord on the pred's lane
+    syncs = EventSynchronizer.make_syncs(g, seq, b)
+    assert len(syncs) == 1
+    rec = syncs[0]
+    assert isinstance(rec, EventRecord) and rec.lane() == Lane(0)
+    seq.push_back(rec)
+    assert not EventSynchronizer.is_synced(g, seq, b)
+
+    # step 2: the matching WaitEvent on the op's lane
+    syncs = EventSynchronizer.make_syncs(g, seq, b)
+    assert len(syncs) == 1
+    w = syncs[0]
+    assert isinstance(w, WaitEvent) and w.lane() == Lane(1) and w.event() == rec.event()
+    seq.push_back(w)
+    assert EventSynchronizer.is_synced(g, seq, b)
+    assert EventSynchronizer.make_syncs(g, seq, b) == []
+
+
+def test_device_then_host_needs_record_then_sync():
+    a, c = KOp("a").bind(Lane(0)), NoOp("c")
+    g = two_op_graph(a, c)
+    seq = Sequence([g.start(), a])
+    assert not EventSynchronizer.is_synced(g, seq, c)
+    rec = EventSynchronizer.make_syncs(g, seq, c)[0]
+    assert isinstance(rec, EventRecord)
+    seq.push_back(rec)
+    es = EventSynchronizer.make_syncs(g, seq, c)[0]
+    assert isinstance(es, EventSync) and es.event() == rec.event()
+    seq.push_back(es)
+    assert EventSynchronizer.is_synced(g, seq, c)
+
+
+def test_device_then_host_lane_sync_also_counts():
+    a, c = KOp("a").bind(Lane(0)), NoOp("c")
+    g = two_op_graph(a, c)
+    seq = Sequence([g.start(), a, LaneSync(Lane(0))])
+    assert EventSynchronizer.is_synced(g, seq, c)
+
+
+def test_record_before_pred_does_not_count():
+    a, b = KOp("a").bind(Lane(0)), KOp("b").bind(Lane(1))
+    g = two_op_graph(a, b)
+    # record issued BEFORE a ran captures nothing of a
+    seq = Sequence([g.start(), EventRecord(Lane(0), Event(0)), a])
+    assert not EventSynchronizer.is_synced(g, seq, b)
+    seq2 = Sequence([g.start(), EventRecord(Lane(0), Event(0)), a, WaitEvent(Lane(1), Event(0))])
+    assert not EventSynchronizer.is_synced(g, seq2, b)
+
+
+def test_two_preds_same_lane_share_one_record():
+    a, b = KOp("a").bind(Lane(0)), KOp("b").bind(Lane(0))
+    c = KOp("c").bind(Lane(1))
+    g = Graph()
+    g.start_then(a)
+    g.start_then(b)
+    g.then(a, c)
+    g.then(b, c)
+    g.then_finish(c)
+    seq = Sequence([g.start(), a, b])
+    syncs = EventSynchronizer.make_syncs(g, seq, c)
+    # one record on lane 0 covers both preds
+    assert len(syncs) == 1 and isinstance(syncs[0], EventRecord)
+
+
+def test_two_preds_distinct_lanes_two_records_fresh_events():
+    a, b = KOp("a").bind(Lane(0)), KOp("b").bind(Lane(1))
+    c = KOp("c").bind(Lane(2))
+    g = Graph()
+    g.start_then(a)
+    g.start_then(b)
+    g.then(a, c)
+    g.then(b, c)
+    g.then_finish(c)
+    seq = Sequence([g.start(), a, b])
+    syncs = EventSynchronizer.make_syncs(g, seq, c)
+    assert len(syncs) == 2
+    assert {s.lane() for s in syncs} == {Lane(0), Lane(1)}
+    # fresh events must be distinct
+    assert syncs[0].event() != syncs[1].event()
