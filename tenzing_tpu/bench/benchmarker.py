@@ -1,0 +1,195 @@
+"""Empirical and recorded benchmarking of candidate schedules.
+
+Parity target: reference ``include/tenzing/benchmarker.hpp`` /
+``src/benchmarker.cpp``:
+
+* ``Benchmark.Result`` = percentiles 01/10/50/90/99 + stddev of per-iteration
+  wall time (benchmarker.hpp:14-22).
+* ``EmpiricalBenchmarker`` — adaptive inner loop grows samples-per-measurement
+  until one measurement takes >= 10 ms (benchmarker.cpp:83-119); barrier before,
+  wall-clock around the loop, **max across hosts** (benchmarker.cpp:101,145);
+  nIters measurements; reject the whole set if the runs-test flags non-random
+  structure and retry up to maxRetries (benchmarker.cpp:129-155).
+* ``CsvBenchmarker`` — replays a recorded ``idx|pct...|stddev|json-op...`` CSV
+  database, answering queries by bijection-equivalence matching of the query
+  sequence against stored rows (benchmarker.cpp:169-223): search-algorithm
+  experiments need no device at all.
+
+TPU note: the executor compiles a schedule to one XLA program; ``run_once`` must
+call the compiled function AND ``block_until_ready`` so a measurement fences the
+device (SURVEY.md §7.2 "Measurement fidelity").  Compile time is excluded: the
+callable is built once per schedule before timing starts.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Protocol, Tuple
+
+from tenzing_tpu.bench.randomness import is_random
+from tenzing_tpu.core.resources import Equivalence
+from tenzing_tpu.core.sequence import Sequence, get_equivalence
+from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
+from tenzing_tpu.utils.numeric import percentile, stddev
+
+
+@dataclass
+class BenchResult:
+    """Percentile statistics of per-iteration wall time in seconds
+    (reference Benchmark::Result, benchmarker.hpp:14-22)."""
+
+    pct01: float = 0.0
+    pct10: float = 0.0
+    pct50: float = 0.0
+    pct90: float = 0.0
+    pct99: float = 0.0
+    stddev: float = 0.0
+
+    @staticmethod
+    def from_times(times: List[float]) -> "BenchResult":
+        s = sorted(times)
+        return BenchResult(
+            pct01=percentile(s, 1),
+            pct10=percentile(s, 10),
+            pct50=percentile(s, 50),
+            pct90=percentile(s, 90),
+            pct99=percentile(s, 99),
+            stddev=stddev(s),
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "pct01": self.pct01,
+            "pct10": self.pct10,
+            "pct50": self.pct50,
+            "pct90": self.pct90,
+            "pct99": self.pct99,
+            "stddev": self.stddev,
+        }
+
+
+@dataclass
+class BenchOpts:
+    """reference Benchmark::Opts (benchmarker.hpp:24-30)."""
+
+    n_iters: int = 1000
+    max_retries: int = 10
+    target_secs: float = 0.01  # adaptive floor per measurement (benchmarker.cpp:85)
+
+
+class ScheduleRunner(Protocol):
+    """Anything that turns a schedule into a zero-arg fenced run callable —
+    provided by runtime.executor."""
+
+    def prepare(self, order: Sequence) -> Callable[[], None]: ...
+
+
+class EmpiricalBenchmarker:
+    """Times a schedule on the real device (reference EmpiricalBenchmarker)."""
+
+    def __init__(
+        self,
+        runner: ScheduleRunner,
+        control_plane: Optional[ControlPlane] = None,
+    ):
+        self.runner = runner
+        self.cp = control_plane if control_plane is not None else default_control_plane()
+
+    # reference measure(), benchmarker.cpp:83-119
+    def _measure(self, run_once: Callable[[], None], n_samples: int, opts: BenchOpts) -> Tuple[float, int]:
+        """One measurement: time >= target_secs of work; returns (secs-per-sample,
+        possibly-grown n_samples)."""
+        while True:
+            self.cp.barrier()
+            t0 = time.perf_counter()
+            for _ in range(n_samples):
+                run_once()
+            elapsed = time.perf_counter() - t0
+            elapsed = self.cp.allreduce_max(elapsed)
+            if elapsed >= opts.target_secs:
+                return elapsed / n_samples, n_samples
+            grow = max(n_samples * 2, int(n_samples * 1.5 * opts.target_secs / max(elapsed, 1e-9)))
+            n_samples = min(grow, 1_000_000)
+
+    # reference benchmark(), benchmarker.cpp:121-167
+    def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
+        opts = opts if opts is not None else BenchOpts()
+        run_once = self.runner.prepare(order)
+        run_once()  # warmup: compile + first dispatch excluded from timing
+        n_samples = 1
+        for attempt in range(opts.max_retries):
+            times: List[float] = []
+            for _ in range(opts.n_iters):
+                # _measure already max-reduces each elapsed across hosts
+                t, n_samples = self._measure(run_once, n_samples, opts)
+                times.append(t)
+            if is_random(times) or attempt == opts.max_retries - 1:
+                return BenchResult.from_times(times)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+# -- recorded-timings replay (reference CsvBenchmarker, benchmarker.cpp:169-223) --
+
+CSV_DELIM = "|"
+
+
+def result_row(idx: int, res: BenchResult, order: Sequence) -> str:
+    """One CSV row: ``idx|pct01|pct10|pct50|pct90|pct99|stddev|op-json|...``
+    (reference mcts.cpp:13-31 / dfs.cpp:84-105 dump format)."""
+    import json
+
+    cells = [
+        str(idx),
+        repr(res.pct01),
+        repr(res.pct10),
+        repr(res.pct50),
+        repr(res.pct90),
+        repr(res.pct99),
+        repr(res.stddev),
+    ] + [
+        # '|' can only occur inside JSON strings; the \\u007c escape keeps the
+        # cell valid JSON while making the row safely splittable on the delimiter
+        json.dumps(op.to_json()).replace(CSV_DELIM, "\\u007c")
+        for op in order
+    ]
+    return CSV_DELIM.join(cells)
+
+
+class CsvBenchmarker:
+    """Answers benchmark queries from a recorded database by equivalence-matching
+    the query sequence against stored schedules — search experiments with no
+    device in the loop (reference benchmarker.cpp:169-223)."""
+
+    def __init__(self, rows: List[str], graph):
+        from tenzing_tpu.core.serdes import op_from_json
+        import json
+
+        self.entries: List[Tuple[Sequence, BenchResult]] = []
+        for row in rows:
+            if not row.strip():
+                continue
+            cells = row.split(CSV_DELIM)
+            res = BenchResult(
+                pct01=float(cells[1]),
+                pct10=float(cells[2]),
+                pct50=float(cells[3]),
+                pct90=float(cells[4]),
+                pct99=float(cells[5]),
+                stddev=float(cells[6]),
+            )
+            ops = [op_from_json(json.loads(c), graph) for c in cells[7:]]
+            self.entries.append((Sequence(ops), res))
+
+    @classmethod
+    def from_file(cls, path: str, graph) -> "CsvBenchmarker":
+        with open(path) as f:
+            return cls(f.read().splitlines(), graph)
+
+    def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
+        for stored, res in self.entries:
+            if get_equivalence(stored, order):
+                return res
+        raise KeyError(
+            f"no recorded schedule equivalent to: {order.desc()}"
+        )

@@ -1,0 +1,89 @@
+"""JSON serialization of schedules, anchored to a graph on deserialization.
+
+Parity target: reference ``include/tenzing/operation_serdes.hpp`` /
+``src/operation_serdes.cpp``: ops serialize themselves (``OpBase.to_json``);
+deserialization searches the graph (descending into CompoundOp sub-graphs and
+ChoiceOp choices) for an op whose name matches, rebinding device ops with the
+serialized lane; scheduler-inserted sync ops absent from the graph are
+reconstructed from their ``kind`` field (operation_serdes.cpp:14-76).
+
+This is the foundation of cross-host schedule broadcast (reference
+sequence.cpp:88-125 ``mpi_bcast``; here parallel/control_plane.py) and of the
+recorded-timings benchmarker (bench/benchmarker.py CsvBenchmarker).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import (
+    ChoiceOp,
+    CompoundOp,
+    DeviceOp,
+    OpBase,
+    kind_registry,
+)
+from tenzing_tpu.core.resources import Lane
+from tenzing_tpu.core.sequence import Sequence
+
+
+def sequence_to_json(seq: Sequence) -> List[Dict[str, Any]]:
+    return [op.to_json() for op in seq]
+
+
+def sequence_to_json_str(seq: Sequence) -> str:
+    return json.dumps(sequence_to_json(seq))
+
+
+def _find_by_name(graph: Graph, name: str) -> Optional[OpBase]:
+    """Recursive graph-anchored lookup (reference operation_serdes.cpp:14-56):
+    search vertices, descending into compound sub-graphs and choice alternatives."""
+    for v in graph.vertices():
+        if v.name() == name:
+            return v
+        if isinstance(v, CompoundOp):
+            hit = _find_by_name(v.graph(), name)
+            if hit is not None:
+                return hit
+        if isinstance(v, ChoiceOp):
+            for c in v.choices():
+                if c.name() == name:
+                    return c
+                if isinstance(c, CompoundOp):
+                    hit = _find_by_name(c.graph(), name)
+                    if hit is not None:
+                        return hit
+    return None
+
+
+def op_from_json(j: Dict[str, Any], graph: Graph) -> OpBase:
+    """Re-materialize one op against the local graph (reference
+    operation_serdes.cpp:58-76)."""
+    kind = j.get("kind")
+    registry = kind_registry()
+    cls = registry.get(kind)
+    if cls is not None and hasattr(cls, "from_json"):
+        # scheduler-inserted sync ops carry everything they need
+        return cls.from_json(j)
+    name = j["name"]
+    op = _find_by_name(graph, name)
+    if op is None:
+        raise KeyError(f"op {name!r} not found in graph during deserialization")
+    from tenzing_tpu.core.operation import BoundDeviceOp, unbound
+
+    base = unbound(op)
+    if "lane" in j:
+        if not isinstance(base, DeviceOp):
+            raise TypeError(f"serialized lane on non-device op {name!r}")
+        return base.bind(Lane(j["lane"]))
+    return base
+
+
+def sequence_from_json(j: List[Dict[str, Any]], graph: Graph) -> Sequence:
+    return Sequence([op_from_json(oj, graph) for oj in j])
+
+
+def sequence_from_json_str(s: str, graph: Graph) -> Sequence:
+    return sequence_from_json(json.loads(s), graph)

@@ -1,0 +1,99 @@
+"""Host-side coordination: the control plane of the search.
+
+Parity target: the reference's second use of MPI (SURVEY.md §5 "Distributed
+communication backend"): schedule broadcast (sequence.cpp:88-125 ``mpi_bcast``),
+stop-flag broadcast (mcts.hpp:148-151, dfs.hpp:66-69), and benchmark barriers and
+max-over-hosts reductions (benchmarker.cpp:43-60,101,145).
+
+TPU-native realization: ``jax.process_index``/``process_count`` identify hosts;
+cross-host exchange rides a length-padded uint8 array through
+``multihost_utils.broadcast_one_to_all`` and max-reductions through
+``process_allgather`` — the data plane (ICI/DCN collectives inside schedules)
+lives in the ops, not here.  On a single host every operation degenerates to the
+identity, so the whole search stack runs un-distributed (the reference behaves
+identically under an MPI world of size 1).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+
+class ControlPlane:
+    """Single-host control plane (world size 1) — the default."""
+
+    def rank(self) -> int:
+        return 0
+
+    def size(self) -> int:
+        return 1
+
+    def barrier(self) -> None:
+        return None
+
+    def bcast_json(self, obj: Any) -> Any:
+        """Broadcast a JSON-serializable object from rank 0 (reference
+        mpi_bcast's length+bytes protocol, sequence.cpp:88-125)."""
+        return obj
+
+    def allreduce_max(self, x: float) -> float:
+        """Max over hosts (reference MPI_Allreduce MAX, benchmarker.cpp:101,145)."""
+        return x
+
+
+class JaxControlPlane(ControlPlane):
+    """Multi-host control plane over jax.distributed (requires
+    jax.distributed.initialize to have run)."""
+
+    def __init__(self):
+        import jax
+
+        self._jax = jax
+
+    def rank(self) -> int:
+        return self._jax.process_index()
+
+    def size(self) -> int:
+        return self._jax.process_count()
+
+    def barrier(self) -> None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tenzing_tpu_barrier")
+
+    def bcast_json(self, obj: Any) -> Any:
+        from jax.experimental import multihost_utils
+
+        payload = json.dumps(obj).encode() if self.rank() == 0 else b""
+        n = np.array([len(payload)], dtype=np.int64)
+        n = multihost_utils.broadcast_one_to_all(n)
+        buf = np.zeros(int(n[0]), dtype=np.uint8)
+        if self.rank() == 0:
+            buf[:] = np.frombuffer(payload, dtype=np.uint8)
+        buf = multihost_utils.broadcast_one_to_all(buf)
+        return json.loads(bytes(buf).decode())
+
+    def allreduce_max(self, x: float) -> float:
+        from jax.experimental import multihost_utils
+
+        xs = multihost_utils.process_allgather(np.array([x]))
+        return float(np.max(xs))
+
+
+_DEFAULT: ControlPlane = ControlPlane()
+
+
+def default_control_plane() -> ControlPlane:
+    """The process-global control plane: multi-host iff jax reports >1 process."""
+    global _DEFAULT
+    try:
+        import jax
+
+        if jax.process_count() > 1 and not isinstance(_DEFAULT, JaxControlPlane):
+            _DEFAULT = JaxControlPlane()
+    except Exception:
+        pass
+    return _DEFAULT

@@ -1,0 +1,140 @@
+"""Exhaustive depth-first schedule enumeration + benchmarking.
+
+Parity target: reference ``tenzing-dfs`` (dfs.hpp/dfs.cpp): ``get_all_sequences``
+is a worklist DFS over ``State.frontier`` with equivalence-class dedup at each
+expansion (dfs.cpp:16-82); ``explore`` enumerates on rank 0, dedups completed
+sequences pairwise under resource bijection (dfs.hpp:88-113), broadcasts each
+schedule to all hosts (stop-flag + schedule, dfs.hpp:50-70,145-167), benchmarks
+it, and collects results; SIGINT dumps the partial CSV (dfs.hpp:118-122).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, result_row
+from tenzing_tpu.core import sequence as sequence_mod
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
+from tenzing_tpu.core.state import State
+from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
+from tenzing_tpu.utils import trap
+
+
+@dataclass
+class DfsOpts:
+    """reference dfs::Opts (dfs.hpp:30-40; maxSeqs cap from examples/spmv.cu:117)."""
+
+    max_seqs: int = 15000
+    bench_opts: BenchOpts = field(default_factory=BenchOpts)
+    dump_csv_path: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return {"max_seqs": self.max_seqs, "n_iters": self.bench_opts.n_iters}
+
+
+@dataclass
+class SimResult:
+    """One benchmarked schedule (reference SimResult, dfs.hpp:20-28)."""
+
+    order: Sequence
+    result: BenchResult
+
+
+@dataclass
+class DfsResult:
+    """reference dfs::Result (dfs.hpp:74-76, dump_csv dfs.cpp:84-105)."""
+
+    sims: List[SimResult] = field(default_factory=list)
+
+    def dump_csv(self, path: Optional[str] = None) -> str:
+        rows = [result_row(i, s.result, s.order) for i, s in enumerate(self.sims)]
+        text = "\n".join(rows) + ("\n" if rows else "")
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def best(self) -> Optional[SimResult]:
+        if not self.sims:
+            return None
+        return min(self.sims, key=lambda s: s.result.pct10)
+
+
+def get_all_sequences(
+    graph: Graph, platform, max_seqs: int = 15000
+) -> List[State]:
+    """All complete schedules reachable from the initial state, deduplicating
+    equivalent states at every expansion (reference get_all_sequences,
+    dfs.cpp:16-82; the per-expansion dedup is dfs.cpp:46-58)."""
+    terminals: List[State] = []
+    stack: List[State] = [State(graph)]
+    while stack and len(terminals) < max_seqs:
+        st = stack.pop()
+        if st.is_terminal():
+            terminals.append(st)
+            continue
+        stack.extend(st.frontier(platform))
+    return terminals
+
+
+def _dedup_terminal_states(states: List[State]) -> List[State]:
+    """Pairwise dedup of completed schedules under resource bijection
+    (reference dfs.hpp:88-113)."""
+    uniq: List[State] = []
+    for s in states:
+        if not any(
+            sequence_mod.get_equivalence(s.sequence, u.sequence) for u in uniq
+        ):
+            uniq.append(s)
+    return uniq
+
+
+def explore(
+    graph: Graph,
+    platform,
+    benchmarker,
+    opts: Optional[DfsOpts] = None,
+    control_plane: Optional[ControlPlane] = None,
+) -> DfsResult:
+    """Enumerate, dedup, benchmark every schedule (reference dfs::explore,
+    dfs.hpp:78-178)."""
+    opts = opts if opts is not None else DfsOpts()
+    cp = control_plane if control_plane is not None else default_control_plane()
+    result = DfsResult()
+
+    def dump_partial():  # reference dfs.hpp:118-122
+        if opts.dump_csv_path:
+            result.dump_csv(opts.dump_csv_path)
+        else:
+            print(result.dump_csv(), end="")
+
+    trap.register_handler(dump_partial)
+    try:
+        if cp.rank() == 0:
+            states = get_all_sequences(graph, platform, opts.max_seqs)
+            states = _dedup_terminal_states(states)
+            n = len(states)
+        else:
+            states, n = [], 0
+        n = cp.bcast_json(n)  # stop-flag protocol (dfs.hpp:50-70)
+        for i in range(n):
+            if cp.rank() == 0:
+                st = states[i]
+                payload = sequence_to_json(st.sequence)
+            else:
+                st, payload = None, None
+            payload = cp.bcast_json(payload)
+            if cp.rank() == 0:
+                order = st.sequence
+            else:
+                order = sequence_from_json(payload, graph)
+            res = benchmarker.benchmark(order, opts.bench_opts)
+            result.sims.append(SimResult(order=order, result=res))
+        if opts.dump_csv_path and cp.rank() == 0:
+            result.dump_csv(opts.dump_csv_path)
+        return result
+    finally:
+        trap.unregister_handler(dump_partial)

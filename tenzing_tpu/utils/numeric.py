@@ -1,0 +1,74 @@
+"""Numeric helpers (reference include/tenzing/numeric.hpp / src/numeric.cpp):
+avg/med/var/stddev, Pearson correlation (used by MCTS strategies,
+numeric.hpp:57-109), prime factorization for rank-grid layout, round_up."""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+
+def avg(xs: Sequence[float]) -> float:
+    return sum(xs) / len(xs)
+
+
+def med(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n % 2:
+        return s[n // 2]
+    return 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def var(xs: Sequence[float]) -> float:
+    m = avg(xs)
+    return sum((x - m) ** 2 for x in xs) / len(xs)
+
+
+def stddev(xs: Sequence[float]) -> float:
+    return math.sqrt(var(xs))
+
+
+def corr(xs: Sequence[float], ys: Sequence[float]) -> float:
+    """Pearson correlation coefficient (reference numeric.hpp:57-109); 0 when
+    either side is constant."""
+    if len(xs) != len(ys) or not xs:
+        raise ValueError("corr needs two equal-length non-empty series")
+    mx, my = avg(xs), avg(ys)
+    sx, sy = stddev(xs), stddev(ys)
+    if sx == 0.0 or sy == 0.0:
+        return 0.0
+    n = len(xs)
+    cov = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / n
+    return cov / (sx * sy)
+
+
+def prime_factors(n: int) -> List[int]:
+    """Ascending prime factorization (reference numeric.cpp:11-33; used for
+    device-grid layout, halo_run_strategy.hpp:80-98)."""
+    out: List[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def round_up(x: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` >= x (reference numeric.cpp:35-42)."""
+    if multiple <= 0:
+        raise ValueError("multiple must be positive")
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+def percentile(sorted_xs: Sequence[float], pct: float) -> float:
+    """Nearest-rank percentile over a pre-sorted series (reference
+    benchmarker.cpp:157-166 indexing convention)."""
+    if not sorted_xs:
+        raise ValueError("empty series")
+    i = min(len(sorted_xs) - 1, max(0, int(round(pct / 100.0 * (len(sorted_xs) - 1)))))
+    return sorted_xs[i]

@@ -1,0 +1,43 @@
+"""Signal trapping for partial-result dumps.
+
+Parity target: reference ``src/trap.cpp:9-35``: solvers install a SIGINT/SIGABRT
+handler so a wall-clock-limited job (e.g. SLURM ``--signal=SIGABRT@10``) still
+dumps the schedules explored so far before dying."""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import Callable, List, Optional
+
+_callbacks: List[Callable[[], None]] = []
+_prev_handlers: dict = {}
+
+
+def _handler(signum, frame):  # pragma: no cover - signal path
+    for cb in list(_callbacks):
+        try:
+            cb()
+        except Exception as e:
+            print(f"trap: dump callback failed: {e}", file=sys.stderr)
+    signal.signal(signum, signal.SIG_DFL)
+    signal.raise_signal(signum)
+
+
+def register_handler(dump: Callable[[], None]) -> None:
+    """Install ``dump`` to run on SIGINT/SIGABRT (reference register_handler)."""
+    _callbacks.append(dump)
+    if not _prev_handlers:
+        for sig in (signal.SIGINT, signal.SIGABRT):
+            _prev_handlers[sig] = signal.signal(sig, _handler)
+
+
+def unregister_handler(dump: Callable[[], None]) -> None:
+    """Remove a callback; the last removal restores the previous handlers so
+    Ctrl-C behaves normally again outside a search."""
+    if dump in _callbacks:
+        _callbacks.remove(dump)
+    if not _callbacks and _prev_handlers:
+        for sig, prev in _prev_handlers.items():
+            signal.signal(sig, prev)
+        _prev_handlers.clear()

@@ -1,0 +1,137 @@
+"""DFS solver + CsvBenchmarker replay (reference dfs.hpp, benchmarker.cpp:169-223)."""
+
+import pytest
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, CsvBenchmarker, result_row
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import DeviceOp, NoOp
+from tenzing_tpu.core.resources import Lane
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.solve.dfs import DfsOpts, explore, get_all_sequences
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+class FakePlatform:
+    def __init__(self, n):
+        self.lanes = [Lane(i) for i in range(n)]
+
+
+class CountingBenchmarker:
+    """Deterministic fake: schedules get times by call order."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def benchmark(self, order, opts=None):
+        self.calls += 1
+        t = 1.0 / self.calls
+        return BenchResult(pct01=t, pct10=t, pct50=t, pct90=t, pct99=t, stddev=0.0)
+
+
+def chain_graph(names):
+    g = Graph()
+    prev = None
+    for n in names:
+        op = NoOp(n)
+        if prev is None:
+            g.start_then(op)
+        else:
+            g.then(prev, op)
+        prev = op
+    g.then_finish(prev)
+    return g
+
+
+def test_get_all_sequences_chain_has_one_schedule():
+    g = chain_graph(["a", "b", "c"])
+    states = get_all_sequences(g, FakePlatform(1))
+    assert len(states) == 1
+    assert states[0].sequence.desc() == "start, a, b, c, finish"
+
+
+def test_get_all_sequences_dedups_lane_renamings():
+    g = Graph()
+    k = KOp("k")
+    g.start_then(k)
+    g.then_finish(k)
+    states = get_all_sequences(g, FakePlatform(2))
+    # lane0/lane1 bindings are equivalent: exactly one schedule survives
+    assert len(states) == 1
+
+
+def test_explore_benchmarks_each_unique_schedule():
+    g = Graph()
+    a, b = NoOp("a"), NoOp("b")
+    g.start_then(a)
+    g.start_then(b)
+    g.then_finish(a)
+    g.then_finish(b)
+    bench = CountingBenchmarker()
+    res = explore(g, FakePlatform(1), bench, DfsOpts(bench_opts=BenchOpts(n_iters=1)))
+    assert bench.calls == 2
+    assert len(res.sims) == 2
+    best = res.best()
+    assert best is not None and best.result.pct10 == 0.5
+
+
+def test_explore_max_seqs_cap():
+    g = Graph()
+    for n in ["a", "b", "c"]:
+        g.start_then(NoOp(n))
+        g.then_finish(NoOp(n))
+    bench = CountingBenchmarker()
+    res = explore(g, FakePlatform(1), bench, DfsOpts(max_seqs=2))
+    assert len(res.sims) <= 2
+
+
+def test_csv_roundtrip_and_equivalence_lookup():
+    g = Graph()
+    x, y = KOp("x"), KOp("y")
+    g.start_then(x)
+    g.then(x, y)
+    g.then_finish(y)
+    order = Sequence([g.start(), x.bind(Lane(0)), y.bind(Lane(0)), g.finish()])
+    res = BenchResult(pct01=0.1, pct10=0.2, pct50=0.3, pct90=0.4, pct99=0.5, stddev=0.01)
+    row = result_row(0, res, order)
+    db = CsvBenchmarker([row], g)
+    # exact schedule
+    assert db.benchmark(order).pct50 == 0.3
+    # lane-renamed schedule matches by bijection equivalence
+    renamed = Sequence([g.start(), x.bind(Lane(1)), y.bind(Lane(1)), g.finish()])
+    assert db.benchmark(renamed).pct50 == 0.3
+    # a different order does not
+    with pytest.raises(KeyError):
+        db.benchmark(Sequence([g.start(), y.bind(Lane(0)), x.bind(Lane(0)), g.finish()]))
+
+
+def test_csv_handles_delimiter_in_op_name():
+    g = Graph()
+    x = KOp("a|b")  # hostile name containing the CSV delimiter
+    g.start_then(x)
+    g.then_finish(x)
+    order = Sequence([g.start(), x.bind(Lane(0)), g.finish()])
+    res = BenchResult(0.1, 0.1, 0.1, 0.1, 0.1, 0.0)
+    db = CsvBenchmarker([result_row(0, res, order)], g)
+    assert db.benchmark(order).pct10 == 0.1
+
+
+def test_trap_handlers_restored_after_explore():
+    import signal
+
+    before = signal.getsignal(signal.SIGINT)
+    g = chain_graph(["a"])
+    explore(g, FakePlatform(1), CountingBenchmarker(), DfsOpts())
+    assert signal.getsignal(signal.SIGINT) is before
+
+
+def test_dfs_csv_dump_reloads(tmp_path):
+    g = chain_graph(["a", "b"])
+    bench = CountingBenchmarker()
+    path = str(tmp_path / "results.csv")
+    res = explore(g, FakePlatform(1), bench, DfsOpts(dump_csv_path=path))
+    db = CsvBenchmarker.from_file(path, g)
+    assert db.benchmark(res.sims[0].order).pct50 == res.sims[0].result.pct50

@@ -1,0 +1,74 @@
+"""Numeric helpers and the runs-test (reference numeric.cpp, randomness.cpp)."""
+
+import random
+
+import pytest
+
+from tenzing_tpu.bench.randomness import is_random, runs_test_z
+from tenzing_tpu.utils.numeric import (
+    avg,
+    corr,
+    med,
+    percentile,
+    prime_factors,
+    round_up,
+    stddev,
+)
+
+
+def test_stats():
+    xs = [1.0, 2.0, 3.0, 4.0]
+    assert avg(xs) == 2.5
+    assert med(xs) == 2.5
+    assert med([1.0, 2.0, 9.0]) == 2.0
+    assert stddev([2.0, 2.0]) == 0.0
+
+
+def test_corr():
+    xs = [1.0, 2.0, 3.0]
+    assert corr(xs, xs) == pytest.approx(1.0)
+    assert corr(xs, [3.0, 2.0, 1.0]) == pytest.approx(-1.0)
+    assert corr(xs, [5.0, 5.0, 5.0]) == 0.0
+    with pytest.raises(ValueError):
+        corr([1.0], [1.0, 2.0])
+
+
+def test_prime_factors():
+    assert prime_factors(12) == [2, 2, 3]
+    assert prime_factors(7) == [7]
+    assert prime_factors(1) == []
+    # device-grid factorization use case: 8 chips -> 2x2x2
+    assert prime_factors(8) == [2, 2, 2]
+
+
+def test_round_up():
+    assert round_up(5, 4) == 8
+    assert round_up(8, 4) == 8
+    with pytest.raises(ValueError):
+        round_up(3, 0)
+
+
+def test_percentile():
+    xs = sorted(float(i) for i in range(101))
+    assert percentile(xs, 1) == 1.0
+    assert percentile(xs, 50) == 50.0
+    assert percentile(xs, 99) == 99.0
+
+
+def test_runs_test_accepts_iid_noise():
+    rng = random.Random(0)
+    xs = [rng.random() for _ in range(200)]
+    assert is_random(xs)
+
+
+def test_runs_test_rejects_drift():
+    # monotone drift = 2 runs, far too few
+    xs = [float(i) for i in range(200)]
+    assert not is_random(xs)
+    assert runs_test_z(xs) < -1.96
+
+
+def test_runs_test_rejects_alternation():
+    xs = [float(i % 2) for i in range(200)]
+    assert not is_random(xs)
+    assert runs_test_z(xs) > 1.96

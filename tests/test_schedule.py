@@ -1,0 +1,180 @@
+"""remove_redundant_syncs peephole rules (reference schedule.cpp:19-321) and the
+legacy whole-space enumerators."""
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import DeviceOp, NoOp, Start
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.schedule import (
+    make_schedules,
+    make_schedules_random,
+    remove_redundant_syncs,
+)
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.sync_ops import EventRecord, EventSync, LaneSync, WaitEvent
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def k(name, lane):
+    return KOp(name).bind(Lane(lane))
+
+
+def descs(seq):
+    return [op.desc() for op in seq]
+
+
+def test_rule1_unconsumed_record_dropped():
+    seq = Sequence([Start(), k("a", 0), EventRecord(Lane(0), Event(0))])
+    out = remove_redundant_syncs(seq)
+    assert descs(out) == ["start", "a@lane0"]
+
+
+def test_rule2_wait_without_later_device_dropped():
+    # wait on lane1 but nothing ever runs on lane1 afterwards
+    seq = Sequence(
+        [
+            Start(),
+            k("a", 0),
+            EventRecord(Lane(0), Event(0)),
+            WaitEvent(Lane(1), Event(0)),
+        ]
+    )
+    out = remove_redundant_syncs(seq)
+    # wait dropped; then the record is unconsumed and dropped too
+    assert descs(out) == ["start", "a@lane0"]
+
+
+def test_useful_record_wait_pair_kept():
+    seq = Sequence(
+        [
+            Start(),
+            k("a", 0),
+            EventRecord(Lane(0), Event(0)),
+            WaitEvent(Lane(1), Event(0)),
+            k("b", 1),
+        ]
+    )
+    out = remove_redundant_syncs(seq)
+    assert len(out) == 5  # nothing removable
+
+
+def test_rule3_duplicate_lane_syncs():
+    seq = Sequence([Start(), k("a", 0), LaneSync(Lane(0)), LaneSync(Lane(0)), NoOp("c")])
+    out = remove_redundant_syncs(seq)
+    assert descs(out) == ["start", "a@lane0", "LaneSync(lane0)", "c"]
+
+
+def test_rule4_duplicate_records_merged():
+    # two records at the same lane point; consumers of the second rewritten
+    seq = Sequence(
+        [
+            Start(),
+            k("a", 0),
+            EventRecord(Lane(0), Event(0)),
+            EventRecord(Lane(0), Event(1)),
+            WaitEvent(Lane(1), Event(0)),
+            WaitEvent(Lane(1), Event(1)),
+            k("b", 1),
+        ]
+    )
+    out = remove_redundant_syncs(seq)
+    # one record survives; one wait survives (the rewritten duplicate collapses
+    # to an identical wait, which rule 5 then removes)
+    evs = [op for op in out if isinstance(op, EventRecord)]
+    assert len(evs) == 1
+    waits = [op for op in out if isinstance(op, WaitEvent)]
+    assert len(waits) == 1 and waits[0].event() == evs[0].event()
+
+
+def test_rule5_covered_pair_dropped():
+    # e0 recorded, then e1 recorded later on same lane; e1 waited first, so the
+    # later wait on e0 adds nothing
+    seq = Sequence(
+        [
+            Start(),
+            k("a", 0),
+            EventRecord(Lane(0), Event(0)),
+            k("a2", 0),
+            EventRecord(Lane(0), Event(1)),
+            WaitEvent(Lane(1), Event(1)),
+            WaitEvent(Lane(1), Event(0)),
+            k("b", 1),
+        ]
+    )
+    out = remove_redundant_syncs(seq)
+    waits = [op for op in out if isinstance(op, WaitEvent)]
+    assert len(waits) == 1 and waits[0].event() == Event(1)
+    recs = [op for op in out if isinstance(op, EventRecord)]
+    assert len(recs) == 1 and recs[0].event() == Event(1)
+
+
+def test_rule2_keeps_transitive_sync_chain():
+    # a@L0 -> (record,wait via L1) -> (record,wait) -> b@L2: the L1 hop has no
+    # device op but its token is snapshotted by the second record — keep all
+    seq = Sequence(
+        [
+            Start(),
+            k("a", 0),
+            EventRecord(Lane(0), Event(0)),
+            WaitEvent(Lane(1), Event(0)),
+            EventRecord(Lane(1), Event(1)),
+            WaitEvent(Lane(2), Event(1)),
+            k("b", 2),
+        ]
+    )
+    out = remove_redundant_syncs(seq)
+    assert len(out) == 7
+
+
+def test_rule4_wait_advances_lane_point():
+    # two records on L0 separated by a WaitEvent joining c@L2's work: they
+    # capture different progress and must NOT merge
+    seq = Sequence(
+        [
+            Start(),
+            k("a", 0),
+            k("c", 2),
+            EventRecord(Lane(0), Event(0)),
+            EventRecord(Lane(2), Event(9)),
+            WaitEvent(Lane(0), Event(9)),
+            EventRecord(Lane(0), Event(1)),
+            WaitEvent(Lane(1), Event(0)),
+            k("x", 1),
+            WaitEvent(Lane(3), Event(1)),
+            k("b", 3),
+        ]
+    )
+    out = remove_redundant_syncs(seq)
+    evs = [op for op in out if isinstance(op, EventRecord)]
+    assert any(op.event() == Event(1) for op in evs)
+    assert any(op.event() == Event(9) for op in evs)
+
+
+def test_make_schedules_enumerates_topological_orders():
+    g = Graph()
+    a, b = NoOp("a"), NoOp("b")
+    g.start_then(a)
+    g.start_then(b)
+    g.then_finish(a)
+    g.then_finish(b)
+    scheds = make_schedules(g)
+    assert len(scheds) == 2
+    assert {s.desc() for s in scheds} == {
+        "start, a, b, finish",
+        "start, b, a, finish",
+    }
+
+
+def test_make_schedules_random_seeded_deterministic():
+    g = Graph()
+    for n in ["a", "b", "c"]:
+        g.start_then(NoOp(n))
+        g.then_finish(NoOp(n))
+    s1 = make_schedules_random(g, 5, seed=42)
+    s2 = make_schedules_random(g, 5, seed=42)
+    assert [s.desc() for s in s1] == [s.desc() for s in s2]
+    s3 = make_schedules_random(g, 5, seed=7)
+    assert [s.desc() for s in s1] != [s.desc() for s in s3]  # overwhelmingly likely

@@ -1,0 +1,85 @@
+"""Graph-anchored schedule serdes (reference operation_serdes.cpp:14-76)."""
+
+import pytest
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import (
+    BoundDeviceOp,
+    ChoiceOp,
+    CompoundOp,
+    DeviceOp,
+    NoOp,
+)
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.serdes import (
+    sequence_from_json_str,
+    sequence_to_json_str,
+)
+from tenzing_tpu.core.sync_ops import EventRecord, EventSync, WaitEvent
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def test_roundtrip_with_syncs_and_bindings():
+    g = Graph()
+    a, b = KOp("a"), KOp("b")
+    g.start_then(a)
+    g.then(a, b)
+    g.then_finish(b)
+    seq = Sequence(
+        [
+            g.start(),
+            a.bind(Lane(0)),
+            EventRecord(Lane(0), Event(0)),
+            WaitEvent(Lane(1), Event(0)),
+            b.bind(Lane(1)),
+            EventRecord(Lane(1), Event(1)),
+            EventSync(Event(1)),
+            g.finish(),
+        ]
+    )
+    s = sequence_to_json_str(seq)
+    out = sequence_from_json_str(s, g)
+    assert len(out) == len(seq)
+    assert out.desc() == seq.desc()
+    # device ops re-materialized as graph-anchored bound ops
+    assert isinstance(out[1], BoundDeviceOp) and out[1].lane() == Lane(0)
+    assert out[1].unbound() is a  # the local graph's own op object
+
+
+def test_deserialize_descends_into_compound():
+    class Pair(CompoundOp):
+        def graph(self):
+            ig = Graph()
+            x = KOp("x")
+            ig.start_then(x)
+            ig.then_finish(x)
+            return ig
+
+    g = Graph()
+    g.start_then(Pair("pair"))
+    g.then_finish(Pair("pair"))
+    out = sequence_from_json_str('[{"kind": "device", "name": "x", "lane": 1}]', g)
+    assert isinstance(out[0], BoundDeviceOp) and out[0].name() == "x"
+
+
+def test_deserialize_descends_into_choices():
+    class Variant(ChoiceOp):
+        def choices(self):
+            return [KOp("fast"), KOp("slow")]
+
+    g = Graph()
+    g.start_then(Variant("v"))
+    g.then_finish(Variant("v"))
+    out = sequence_from_json_str('[{"kind": "device", "name": "slow", "lane": 0}]', g)
+    assert out[0].name() == "slow"
+
+
+def test_unknown_op_raises():
+    g = Graph()
+    with pytest.raises(KeyError):
+        sequence_from_json_str('[{"kind": "device", "name": "ghost"}]', g)
