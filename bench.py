@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Driver benchmark: searched schedule vs naive sequential ordering on the
+distributed-SpMV iteration (reference config: m=150000 rows, nnz=10*m, band
+matrix, 2 lanes — spmv_run_strategy.cuh:44-47; protocol BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <best searched pct50, us>, "unit": "us",
+   "vs_baseline": <naive_pct50 / best_pct50>}
+
+vs_baseline > 1 means the searched schedule beats the naive sequential order.
+
+``--smoke`` runs a tiny CPU-friendly configuration (used by tests/CI).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny CPU config")
+    ap.add_argument("--m", type=int, default=None, help="matrix rows")
+    ap.add_argument("--candidates", type=int, default=8, help="max unique schedules to time")
+    ap.add_argument("--iters", type=int, default=20, help="measurements per schedule")
+    args = ap.parse_args()
+
+    if args.smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.operation import BoundDeviceOp
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.resources import Lane
+    from tenzing_tpu.core.sequence import Sequence
+    from tenzing_tpu.core import sequence as sequence_mod
+    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.dfs import get_all_sequences
+    from tenzing_tpu.core.state import State
+
+    m = args.m if args.m is not None else (512 if args.smoke else 150_000)
+    bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, seed=0)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, bufs)
+    bench = EmpiricalBenchmarker(ex)
+    opts = BenchOpts(n_iters=max(5, args.iters), target_secs=0.002 if args.smoke else 0.01)
+
+    # naive baseline: expand the compound, bind every device op to lane 0,
+    # execute in topological (frontier) order — the reference's "sequential
+    # ordering on one stream" baseline (BASELINE.json north star)
+    naive_plat = Platform.make_n_lanes(1)
+    naive_state = State(g)
+    while not naive_state.is_terminal():
+        naive_state = naive_state.apply(naive_state.get_decisions(naive_plat)[0])
+    naive_order = naive_state.sequence
+    t0 = time.time()
+    naive = bench.benchmark(naive_order, opts)
+    sys.stderr.write(f"naive: pct50={naive.pct50*1e6:.1f}us (wall {time.time()-t0:.0f}s)\n")
+
+    # search: enumerate 2-lane schedules, dedup by bijection equivalence, time a
+    # capped candidate set
+    states = get_all_sequences(g, plat, max_seqs=200)
+    uniq = []
+    for st in states:
+        if not any(sequence_mod.get_equivalence(st.sequence, u.sequence) for u in uniq):
+            uniq.append(st)
+        if len(uniq) >= 8 * args.candidates:
+            break
+    if len(uniq) > args.candidates:  # spread candidates across the space
+        stride = len(uniq) / args.candidates
+        uniq = [uniq[int(i * stride)] for i in range(args.candidates)]
+    best = None
+    best_res = None
+    for i, st in enumerate(uniq):
+        t0 = time.time()
+        res = bench.benchmark(st.sequence, opts)
+        sys.stderr.write(
+            f"sched {i}/{len(uniq)}: pct50={res.pct50*1e6:.1f}us "
+            f"(wall {time.time()-t0:.0f}s)\n"
+        )
+        if best_res is None or res.pct50 < best_res.pct50:
+            best, best_res = st, res
+
+    value_us = best_res.pct50 * 1e6
+    vs = naive.pct50 / best_res.pct50
+    print(
+        json.dumps(
+            {
+                "metric": "spmv_iter_pct50_searched_m%d" % m,
+                "value": round(value_us, 2),
+                "unit": "us",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,7 @@ operations.  Remaining implementation freedom — the total order of operations,
 assignment of device ops to execution *lanes*, the insertion of synchronization ops
 that make a given order legal, and choices among implementation variants — is a
 sequential decision problem searched by exhaustive DFS (`tenzing_tpu.solve.dfs`) and
-Monte-Carlo tree search (`tenzing_tpu.solve.mcts`).  Every candidate schedule is
+Monte-Carlo tree search (`tenzing_tpu.solve.mcts`, in progress).  Every candidate schedule is
 lowered to a single XLA program whose dependency structure *is* the schedule
 (token-threaded lanes, see `tenzing_tpu.runtime.executor`) and empirically
 benchmarked on the device.

@@ -1,0 +1,113 @@
+"""The TPU platform: lanes, device mesh, buffer shardings, event provisioning.
+
+Parity target: reference ``include/tenzing/platform.hpp`` / ``src/platform.cpp``:
+``Platform`` owns the real streams + MPI communicator + a ``ResourceMap`` from
+virtual events to ``cudaEvent_t`` (platform.hpp:131-144), with
+``Platform::make_n_streams`` (platform.hpp:211-215) and a ``CudaEventPool``
+amortizing event creation across search iterations (platform.hpp:221-242).
+
+TPU-native redesign (fixing the reference's own "Platform mixes static and
+per-order resources" design issue, README.md:59-71): the immutable platform
+description (lanes, mesh, buffer partition specs) is separate from per-schedule
+provisioning.  Lanes and events are *structural* — they become
+optimization-barrier token chains and cross-lane token edges when the schedule is
+traced (runtime/executor.py) — so "provisioning an event" allocates a token slot,
+not a device object.  ``EventPool``/``ResourceMap`` keep the reference's
+provisioning API shape so the solvers' per-candidate reset loop
+(mcts.hpp:247-270, dfs.hpp:145-167) carries over.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tenzing_tpu.core.resources import Event, Lane
+
+
+class ResourceMap:
+    """Virtual Event -> provisioned token slot (reference ResourceMap,
+    platform.hpp:131-144; slots are symbolic on TPU)."""
+
+    def __init__(self) -> None:
+        self._slots: Dict[Event, int] = {}
+
+    def insert(self, event: Event, slot: int) -> None:
+        self._slots[event] = slot
+
+    def __contains__(self, event: Event) -> bool:
+        return event in self._slots
+
+    def __getitem__(self, event: Event) -> int:
+        return self._slots[event]
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def clear(self) -> None:
+        self._slots.clear()
+
+
+class EventPool:
+    """Amortized event provisioning (reference CudaEventPool,
+    platform.hpp:221-242): ``reset()`` between candidate schedules, ``get()``
+    hands out slots."""
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def reset(self) -> None:
+        self._next = 0
+
+    def get(self) -> int:
+        slot = self._next
+        self._next += 1
+        return slot
+
+
+class Platform:
+    """Immutable execution context: virtual lanes, the device mesh, and the
+    partition specs of named buffers (reference Platform, platform.hpp:131-215).
+
+    ``mesh``/``axis_names`` describe the SPMD decomposition: when set, schedules
+    are traced under ``shard_map`` over the mesh and comm ops may use collectives
+    over the named axes.  ``specs`` maps buffer name -> ``PartitionSpec`` (default
+    fully replicated)."""
+
+    def __init__(
+        self,
+        lanes: List[Lane],
+        mesh=None,
+        specs: Optional[Dict[str, object]] = None,
+    ):
+        self.lanes = lanes
+        self.mesh = mesh
+        self.specs = dict(specs) if specs else {}
+        self.event_pool = EventPool()
+        self.resource_map = ResourceMap()
+
+    @staticmethod
+    def make_n_lanes(n: int, mesh=None, specs: Optional[Dict[str, object]] = None) -> "Platform":
+        """reference Platform::make_n_streams (platform.hpp:211-215)."""
+        return Platform([Lane(i) for i in range(n)], mesh=mesh, specs=specs)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names) if self.mesh is not None else ()
+
+    def spec(self, name: str):
+        """Partition spec for buffer ``name`` (replicated when unspecified)."""
+        if name in self.specs:
+            return self.specs[name]
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec()
+
+    def provision_events(self, events: Iterable[Event]) -> ResourceMap:
+        """Per-candidate event provisioning (reference mcts.hpp:247-270 /
+        dfs.hpp:145-167 reset loop)."""
+        self.event_pool.reset()
+        self.resource_map.clear()
+        for e in events:
+            if e not in self.resource_map:
+                self.resource_map.insert(e, self.event_pool.get())
+        return self.resource_map

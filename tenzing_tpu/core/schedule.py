@@ -166,7 +166,9 @@ def _rule_covered_pairs(order: List[OpBase]) -> Optional[List[OpBase]]:
             for c1 in cons1:
                 o1 = order[c1]
                 for c2 in cons2:
-                    if c2 > c1:
+                    # e2's wait must itself be effective: after e2's record and
+                    # at-or-before e1's wait
+                    if c2 > c1 or c2 < p2:
                         continue
                     o2 = order[c2]
                     same_scope = (

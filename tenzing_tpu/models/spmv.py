@@ -1,0 +1,360 @@
+"""Distributed SpMV workload: y = A @ x, row-partitioned, local/remote split.
+
+Parity target: reference ``include/tenzing/spmv/`` + ``src/spmv/`` (C12 in
+SURVEY.md §2): CSR/COO host structures (csr_mat.hpp, coo_mat.hpp), random band
+matrix generators (csr_mat.hpp:299-369), 1-D block partition helpers
+(partition.hpp:11-75), local/remote column split + renumbering
+(split_mat.hpp:22-136), the ``RowPartSpmv`` setup engine (row_part_spmv.cuh), the
+device ops SpMVKernel/Scatter/VectorAdd (ops_spmv.cuh:61-215 — VectorAdd is
+actually implemented here, fixing the reference's no-op defect,
+src/spmv/ops_spmv.cu:44-46 / SURVEY.md §7.3), and the ``SpMV`` CompoundOp wiring
+the whole dataflow (ops_spmv.cuh:306-436).
+
+TPU-native design: the sparse kernel avoids cuSPARSE-style scalar gathers.  A CSR
+matrix is lowered once, host-side, to a dense **band/ELL slab**: values padded to
+a fixed row width with a companion column-index slab.  The SpMV is then
+``sum(vals * x[cols], axis=1)`` — a gather + VPU multiply-reduce over a static
+shape, which XLA vectorizes and tiles; for the band matrices of the reference's
+benchmark the slab is dense and this is bandwidth-optimal.  The remote half runs
+against the renumbered remote columns exactly like the reference's split SpMV.
+
+The comm ops here are the single-device slice (device-local gather standing for
+the ICI exchange); the multi-chip exchange ops live in models/spmv_dist.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+
+
+# -- host-side matrix structures (reference coo_mat.hpp / csr_mat.hpp) -----------
+
+
+@dataclass
+class CooMat:
+    """Coordinate-format host matrix (reference CooMat, coo_mat.hpp:12-76)."""
+
+    m: int
+    n: int
+    rows: np.ndarray
+    cols: np.ndarray
+    vals: np.ndarray
+
+    def nnz(self) -> int:
+        return len(self.vals)
+
+    def to_csr(self) -> "CsrMat":
+        order = np.lexsort((self.cols, self.rows))
+        rows, cols, vals = self.rows[order], self.cols[order], self.vals[order]
+        indptr = np.zeros(self.m + 1, dtype=np.int32)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr).astype(np.int32)
+        return CsrMat(self.m, self.n, indptr, cols.astype(np.int32), vals)
+
+
+@dataclass
+class CsrMat:
+    """CSR host matrix (reference CsrMat<host>, csr_mat.hpp:34-155)."""
+
+    m: int
+    n: int
+    indptr: np.ndarray
+    cols: np.ndarray
+    vals: np.ndarray
+
+    def nnz(self) -> int:
+        return len(self.vals)
+
+    def retain_rows(self, lo: int, hi: int) -> "CsrMat":
+        """Row slice [lo, hi) (reference retain_rows, csr_mat.hpp:101-155)."""
+        a, b = self.indptr[lo], self.indptr[hi]
+        return CsrMat(
+            hi - lo,
+            self.n,
+            (self.indptr[lo : hi + 1] - a).astype(np.int32),
+            self.cols[a:b],
+            self.vals[a:b],
+        )
+
+    def row_widths(self) -> np.ndarray:
+        return np.diff(self.indptr)
+
+    def to_slab(self, width: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """Lower to a dense (m, width) ELL slab: (vals, cols), zero-padded.
+        Padded entries point at column 0 with value 0 so the gather stays in
+        bounds and contributes nothing."""
+        wmax = int(self.row_widths().max(initial=0))
+        w = int(width) if width is not None else max(1, wmax)
+        if w < wmax:
+            raise ValueError(
+                f"slab width {w} would truncate rows (widest row has {wmax} nonzeros)"
+            )
+        vals = np.zeros((self.m, w), dtype=self.vals.dtype)
+        cols = np.zeros((self.m, w), dtype=np.int32)
+        if self.nnz():
+            rows = np.repeat(np.arange(self.m), self.row_widths())
+            pos = np.arange(self.nnz()) - self.indptr[rows]
+            vals[rows, pos] = self.vals
+            cols[rows, pos] = self.cols
+        return vals, cols
+
+    def matvec(self, x: np.ndarray) -> np.ndarray:
+        """Host-side reference y = A @ x (vectorized; no dense materialization)."""
+        if not self.nnz():
+            return np.zeros(self.m, dtype=self.vals.dtype)
+        rows = np.repeat(np.arange(self.m), self.row_widths())
+        prods = (self.vals.astype(np.float64)) * x.astype(np.float64)[self.cols]
+        return np.bincount(rows, weights=prods, minlength=self.m).astype(self.vals.dtype)
+
+    def toarray(self) -> np.ndarray:
+        """Dense form — small matrices / tests only."""
+        out = np.zeros((self.m, self.n), dtype=self.vals.dtype)
+        for i in range(self.m):
+            for j in range(self.indptr[i], self.indptr[i + 1]):
+                out[i, self.cols[j]] += self.vals[j]
+        return out
+
+
+def random_band_matrix(
+    m: int, bw: int, nnz: int, seed: int = 0, dtype=np.float32
+) -> CsrMat:
+    """Random square band matrix: nnz entries within ``bw`` of the diagonal
+    (reference random_band_matrix, csr_mat.hpp:335-369)."""
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, m, size=nnz)
+    offs = rng.integers(-bw, bw + 1, size=nnz)
+    cols = np.clip(rows + offs, 0, m - 1)
+    vals = rng.random(nnz, dtype=np.float64).astype(dtype)
+    return CooMat(m, m, rows, cols, vals).to_csr()
+
+
+def random_matrix(m: int, n: int, nnz: int, seed: int = 0, dtype=np.float32) -> CsrMat:
+    """Uniform random sparse matrix (reference random_matrix, csr_mat.hpp:299-333)."""
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, m, size=nnz)
+    cols = rng.integers(0, n, size=nnz)
+    vals = rng.random(nnz, dtype=np.float64).astype(dtype)
+    return CooMat(m, n, rows, cols, vals).to_csr()
+
+
+# -- partition helpers (reference partition.hpp:11-75) ---------------------------
+
+
+def part_by_rows(m: int, parts: int) -> List[Tuple[int, int]]:
+    """Contiguous 1-D row partition: ``parts`` (lo, hi) ranges."""
+    base, rem = divmod(m, parts)
+    out = []
+    lo = 0
+    for p in range(parts):
+        hi = lo + base + (1 if p < rem else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+def get_owner(m: int, parts: int, row: int) -> int:
+    """Owning partition of a row (reference get_owner, partition.hpp:43-75)."""
+    for p, (lo, hi) in enumerate(part_by_rows(m, parts)):
+        if lo <= row < hi:
+            return p
+    raise IndexError(row)
+
+
+# -- local/remote split (reference split_mat.hpp:22-136) -------------------------
+
+
+@dataclass
+class SplitMat:
+    """A row-partition's matrix split by column ownership: ``local`` covers
+    owned columns (renumbered to local x indices), ``remote`` covers off-part
+    columns renumbered densely; ``remote_cols`` maps the dense remote index back
+    to the global column."""
+
+    local: CsrMat
+    remote: CsrMat
+    remote_cols: np.ndarray  # global column of each renumbered remote column
+
+
+def split_local_remote(a: CsrMat, col_lo: int, col_hi: int) -> SplitMat:
+    """Split by column range ownership, renumbering both halves
+    (reference split_local_remote, split_mat.hpp:22-136)."""
+    loc_rows, loc_cols, loc_vals = [], [], []
+    rem_rows, rem_cols, rem_vals = [], [], []
+    for i in range(a.m):
+        for j in range(a.indptr[i], a.indptr[i + 1]):
+            c = a.cols[j]
+            if col_lo <= c < col_hi:
+                loc_rows.append(i)
+                loc_cols.append(c - col_lo)
+                loc_vals.append(a.vals[j])
+            else:
+                rem_rows.append(i)
+                rem_cols.append(c)
+                rem_vals.append(a.vals[j])
+    uniq = np.unique(np.asarray(rem_cols, dtype=np.int64)) if rem_cols else np.array([], dtype=np.int64)
+    renum = {c: k for k, c in enumerate(uniq)}
+    local = CooMat(
+        a.m,
+        col_hi - col_lo,
+        np.asarray(loc_rows, dtype=np.int64),
+        np.asarray(loc_cols, dtype=np.int64),
+        np.asarray(loc_vals, dtype=a.vals.dtype),
+    ).to_csr()
+    remote = CooMat(
+        a.m,
+        max(1, len(uniq)),
+        np.asarray(rem_rows, dtype=np.int64),
+        np.asarray([renum[c] for c in rem_cols], dtype=np.int64),
+        np.asarray(rem_vals, dtype=a.vals.dtype),
+    ).to_csr()
+    return SplitMat(local=local, remote=remote, remote_cols=uniq)
+
+
+# -- device ops ------------------------------------------------------------------
+
+
+class SpMVOp(DeviceOp):
+    """ELL-slab SpMV: y = sum(vals * x[cols], axis=1) (reference SpMVKernel,
+    ops_spmv.cuh:61-163 — cuSPARSE there, gather+VPU-reduce here)."""
+
+    def __init__(self, name: str, x: str, y: str, vals: str, cols: str):
+        super().__init__(name)
+        self._x, self._y, self._vals, self._cols = x, y, vals, cols
+
+    def reads(self):
+        return [self._x, self._vals, self._cols]
+
+    def writes(self):
+        return [self._y]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        vals, cols, x = bufs[self._vals], bufs[self._cols], bufs[self._x]
+        return {self._y: jnp.sum(vals * x[cols], axis=1)}
+
+
+class Scatter(DeviceOp):
+    """Gather owned x entries into a contiguous send buffer (reference Scatter,
+    ops_spmv.cuh:194-215)."""
+
+    def __init__(self, name: str, x: str, idx: str, out: str):
+        super().__init__(name)
+        self._x, self._idx, self._out = x, idx, out
+
+    def reads(self):
+        return [self._x, self._idx]
+
+    def writes(self):
+        return [self._out]
+
+    def apply(self, bufs, ctx):
+        return {self._out: bufs[self._x][bufs[self._idx]]}
+
+
+class VectorAdd(DeviceOp):
+    """y = yl + yr (reference VectorAdd — a no-op there,
+    src/spmv/ops_spmv.cu:44-46; implemented here per SURVEY.md §7.3)."""
+
+    def __init__(self, name: str, a: str, b: str, out: str):
+        super().__init__(name)
+        self._a, self._b, self._out = a, b, out
+
+    def reads(self):
+        return [self._a, self._b]
+
+    def writes(self):
+        return [self._out]
+
+    def apply(self, bufs, ctx):
+        return {self._out: bufs[self._a] + bufs[self._b]}
+
+
+class LocalExchange(DeviceOp):
+    """Single-device stand-in for the ICI exchange: moves the scattered send
+    buffer into the remote-x buffer (the multi-chip version is a ppermute-based
+    neighbor exchange, models/spmv_dist.py)."""
+
+    def __init__(self, name: str, src: str, dst: str):
+        super().__init__(name)
+        self._src, self._dst = src, dst
+
+    def reads(self):
+        return [self._src]
+
+    def writes(self):
+        return [self._dst]
+
+    def apply(self, bufs, ctx):
+        return {self._dst: bufs[self._src]}
+
+
+class SpMVCompound(CompoundOp):
+    """The whole SpMV iteration as one compound op (reference SpMV CompoundOp,
+    ops_spmv.cuh:306-436): start -> {local spmv, scatter -> exchange}; exchange
+    -> remote spmv; {local, remote} -> add -> finish."""
+
+    def __init__(self, name: str = "spmv"):
+        super().__init__(name)
+
+    def graph(self) -> Graph:
+        g = Graph()
+        yl = SpMVOp("spmv_local", "x_local", "y_local", "A_loc_vals", "A_loc_cols")
+        scatter = Scatter("scatter", "x_local", "send_idx", "send_buf")
+        exch = LocalExchange("exchange", "send_buf", "x_remote")
+        yr = SpMVOp("spmv_remote", "x_remote", "y_remote", "A_rem_vals", "A_rem_cols")
+        add = VectorAdd("y_add", "y_local", "y_remote", "y")
+        g.start_then(yl)
+        g.start_then(scatter)
+        g.then(scatter, exch)
+        g.then(exch, yr)
+        g.then(yl, add)
+        g.then(yr, add)
+        g.then_finish(add)
+        return g
+
+
+def make_spmv_buffers(
+    m: int = 4096,
+    nnz_per_row: int = 10,
+    bw: Optional[int] = None,
+    seed: int = 0,
+    slab_width: Optional[int] = None,
+) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """Build the buffer dict for the single-device SpMV slice and the dense
+    reference answer.  The matrix is split at the column midpoint to mimic the
+    distributed local/remote structure (reference spmv_run_strategy.cuh:44-47
+    config: m rows, nnz=10*m, band bw)."""
+    bw = bw if bw is not None else max(1, m // 8)
+    a = random_band_matrix(m, bw, nnz_per_row * m, seed=seed)
+    half = m // 2
+    sp = split_local_remote(a, 0, half)
+    lv, lc = sp.local.to_slab(slab_width)
+    rv, rc = sp.remote.to_slab(slab_width)
+    rng = np.random.default_rng(seed + 1)
+    x = rng.random(m, dtype=np.float32)
+    # remote x entries come from the "other rank"'s region via scatter+exchange
+    send_idx = sp.remote_cols.astype(np.int32)
+    if len(send_idx) == 0:  # degenerate split: keep buffer shapes static
+        send_idx = np.zeros(1, dtype=np.int32)
+    bufs = {
+        "x_local": x,  # this slice owns columns [0, half) but keeps full x for the gather
+        "A_loc_vals": lv,
+        "A_loc_cols": lc,
+        "A_rem_vals": rv,
+        "A_rem_cols": rc,
+        "send_idx": send_idx,
+        "send_buf": np.zeros(len(send_idx), dtype=np.float32),
+        "x_remote": np.zeros(len(send_idx), dtype=np.float32),
+        "y_local": np.zeros(m, dtype=np.float32),
+        "y_remote": np.zeros(m, dtype=np.float32),
+        "y": np.zeros(m, dtype=np.float32),
+    }
+    want = a.matvec(x)
+    return bufs, want

@@ -1,0 +1,185 @@
+"""Schedule execution: lower a searched schedule to one compiled XLA program.
+
+This is the TPU-native answer to the reference's dispatch model (SURVEY.md
+§7.0/§7.2).  Where the reference *runs* each op at benchmark time — CUDA kernels
+enqueued on ``cudaStream_t``, ordered by ``cudaEvent_t``
+(benchmarker.cpp:83-119 hot loop, ops_cuda.cpp:48-130) — here the schedule's
+happens-before structure is *traced into the HLO dependency graph* and XLA's
+latency-hiding scheduler executes under exactly those constraints:
+
+* each **lane** is a chain of ``optimization_barrier`` tokens: ops bound to the
+  same lane are serialized in sequence order, ops on different lanes share no
+  chain and may overlap (kernel/DMA/collective overlap is XLA's to exploit);
+* an **EventRecord** snapshots a lane's token; **WaitEvent** joins it into
+  another lane's chain; **EventSync**/**LaneSync** join into the HOST chain —
+  exact analogs of cudaEventRecord / cudaStreamWaitEvent / cudaEventSynchronize
+  / cudaStreamSynchronize;
+* **host ops** (CpuOp) form their own chain (host program order), and every
+  device op joins the host token — a kernel cannot launch before prior host ops,
+  matching CUDA dispatch semantics;
+* **data dependencies are always honored**: buffers are SSA values in a dict, so
+  a searched schedule cannot race — the token edges it chose are a superset of
+  the graph's data edges (the reference achieves the same by the
+  EventSynchronizer's construction, SURVEY.md §5).
+
+Because each candidate schedule is its own compiled program, compile time is
+excluded from measurement (compile once, cache by schedule JSON) and the
+benchmarker fences with ``block_until_ready`` per measurement — SURVEY.md §7.2
+"Measurement fidelity".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tenzing_tpu.core.operation import BoundDeviceOp, OpBase
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.serdes import sequence_to_json_str
+
+
+def _barrier(values):
+    return jax.lax.optimization_barrier(values)
+
+
+class TraceContext:
+    """Mutable tracing state threaded through one schedule trace: the buffer
+    dict (SSA), one token per lane, the host token, and one token per event."""
+
+    def __init__(self, bufs: Dict[str, Any], axis_names=()):
+        self.bufs = bufs
+        self.axis_names = tuple(axis_names)
+        self._zero = jnp.zeros((), jnp.float32)
+        self._lane_tok: Dict[int, Any] = {}
+        self._ev_tok: Dict[int, Any] = {}
+        self._host_tok = self._zero
+
+    # -- token plumbing ----------------------------------------------------
+    def _lane(self, lane: Lane):
+        return self._lane_tok.get(lane.id, self._zero)
+
+    def _join(self, *toks):
+        toks = [t for t in toks if t is not None]
+        if len(toks) == 1:
+            return toks[0]
+        return _barrier(tuple(toks))[0]
+
+    def _tie(self, value, tok):
+        """Value unchanged, but consumers now also wait for ``tok``."""
+        return _barrier((value, tok))[0]
+
+    # -- op tracing --------------------------------------------------------
+    def trace_default(self, op) -> None:
+        """Trace a BoundOp: tie its reads to its chain token, apply, chain the
+        written values back into the token."""
+        is_device = isinstance(op, BoundDeviceOp)
+        if is_device:
+            tok_in = self._join(self._lane(op.lane()), self._host_tok)
+        else:
+            tok_in = self._host_tok
+        view = self.bufs
+        reads = op.reads()
+        if reads:
+            view = dict(self.bufs)
+            for name in reads:
+                view[name] = self._tie(view[name], tok_in)
+        out = op.apply(view, self)
+        for name, val in out.items():
+            if name not in self.bufs:
+                raise KeyError(
+                    f"op {op.desc()!r} writes undeclared buffer {name!r}; declare "
+                    "it in the executor's initial buffers"
+                )
+            self.bufs[name] = val
+        leaves = jax.tree_util.tree_leaves(out)
+        tok_out = _barrier(tuple([tok_in] + leaves))[0] if leaves else tok_in
+        if is_device:
+            self._lane_tok[op.lane().id] = tok_out
+        else:
+            self._host_tok = tok_out
+
+    # -- sync-op hooks (core/sync_ops.py) ----------------------------------
+    def record_event(self, lane: Lane, event: Event) -> None:
+        self._ev_tok[event.id] = self._lane(lane)
+
+    def wait_event(self, lane: Lane, event: Event) -> None:
+        ev = self._ev_tok.get(event.id, self._zero)
+        self._lane_tok[lane.id] = self._join(self._lane(lane), ev)
+
+    def sync_event_host(self, event: Event) -> None:
+        ev = self._ev_tok.get(event.id, self._zero)
+        self._host_tok = self._join(self._host_tok, ev)
+
+    def sync_lane_host(self, lane: Lane) -> None:
+        self._host_tok = self._join(self._host_tok, self._lane(lane))
+
+    def wait_lane(self, waiter: Lane, waitee: Lane) -> None:
+        self._lane_tok[waiter.id] = self._join(self._lane(waiter), self._lane(waitee))
+
+
+class TraceExecutor:
+    """Compiles schedules to XLA programs and runs them (the ``ScheduleRunner``
+    the EmpiricalBenchmarker consumes).
+
+    All buffer names must be declared in ``init_bufs``; when the platform has a
+    mesh, the trace runs under ``shard_map`` with the platform's per-buffer
+    partition specs, and comm ops may use collectives over the mesh axes.
+    """
+
+    def __init__(self, platform: Platform, init_bufs: Dict[str, Any]):
+        self.platform = platform
+        self.init_bufs = dict(init_bufs)
+        self._cache: Dict[str, Callable] = {}
+
+    # -- build -------------------------------------------------------------
+    def _traced(self, ops: List[OpBase], bufs: Dict[str, Any]) -> Dict[str, Any]:
+        tc = TraceContext(dict(bufs), axis_names=self.platform.axis_names)
+        for op in ops:
+            op.trace(tc)
+        return tc.bufs
+
+    def _build(self, order: Sequence) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        """The (unjitted) program for a schedule: trace, then shard_map over the
+        platform mesh when present."""
+        ops = order.vector()
+
+        def fn(bufs: Dict[str, Any]) -> Dict[str, Any]:
+            return self._traced(ops, bufs)
+
+        mesh = self.platform.mesh
+        if mesh is not None:
+            specs = {name: self.platform.spec(name) for name in self.init_bufs}
+            fn = jax.shard_map(fn, mesh=mesh, in_specs=(specs,), out_specs=specs)
+        return fn
+
+    def compile(self, order: Sequence) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        """One jitted program per schedule, cached by schedule JSON."""
+        key = sequence_to_json_str(order)
+        if key in self._cache:
+            return self._cache[key]
+        jitted = jax.jit(self._build(order))
+        self._cache[key] = jitted
+        return jitted
+
+    # -- run ---------------------------------------------------------------
+    def run(self, order: Sequence) -> Dict[str, Any]:
+        """Execute once and return the final buffers (numerical validation)."""
+        return self.compile(order)(self.init_bufs)
+
+    def prepare(self, order: Sequence) -> Callable[[], None]:
+        """Fenced zero-arg runner for the benchmarker: dispatch + block."""
+        f = self.compile(order)
+        bufs = self.init_bufs
+
+        def run_once() -> None:
+            jax.block_until_ready(f(bufs))
+
+        return run_once
+
+    def lowered_text(self, order: Sequence) -> str:
+        """Lowered HLO of a schedule (debugging / tests)."""
+        return jax.jit(self._build(order)).lower(self.init_bufs).as_text()

@@ -1,6 +1,11 @@
 """Test environment: force CPU with 8 virtual devices so multi-chip sharding tests
 run anywhere (SURVEY.md §4: the reference's CI runs the CPU-tagged subset only;
-device tests are opt-in).  Must run before jax is imported anywhere."""
+device tests are opt-in).
+
+Env vars must be set before the CPU backend initializes; the platform must be
+forced via jax.config because an ambient PJRT plugin (e.g. the axon TPU tunnel)
+may register itself at interpreter startup and take priority over JAX_PLATFORMS.
+"""
 
 import os
 
@@ -8,3 +13,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,167 @@
+"""TraceExecutor: schedules compile to XLA programs with the schedule's
+happens-before structure; numerics must match plain evaluation for EVERY legal
+schedule (the by-construction race-freedom of SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import DeviceOp
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.core.resources import Lane
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.runtime.executor import TraceExecutor
+from tenzing_tpu.solve.dfs import get_all_sequences
+
+
+class MatMul(DeviceOp):
+    def __init__(self, name, a, b, out):
+        super().__init__(name)
+        self._a, self._b, self._out = a, b, out
+
+    def reads(self):
+        return [self._a, self._b]
+
+    def writes(self):
+        return [self._out]
+
+    def apply(self, bufs, ctx):
+        return {self._out: bufs[self._a] @ bufs[self._b]}
+
+
+class Add(DeviceOp):
+    def __init__(self, name, a, b, out):
+        super().__init__(name)
+        self._a, self._b, self._out = a, b, out
+
+    def reads(self):
+        return [self._a, self._b]
+
+    def writes(self):
+        return [self._out]
+
+    def apply(self, bufs, ctx):
+        return {self._out: bufs[self._a] + bufs[self._b]}
+
+
+def diamond_graph():
+    """y1 = x@w1; y2 = x@w2; z = y1+y2 — two independent matmuls then a join."""
+    g = Graph()
+    m1 = MatMul("m1", "x", "w1", "y1")
+    m2 = MatMul("m2", "x", "w2", "y2")
+    add = Add("add", "y1", "y2", "z")
+    g.start_then(m1)
+    g.start_then(m2)
+    g.then(m1, add)
+    g.then(m2, add)
+    g.then_finish(add)
+    return g
+
+
+def make_bufs(n=8):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    return {
+        "x": jax.random.normal(k1, (n, n), jnp.float32),
+        "w1": jax.random.normal(k2, (n, n), jnp.float32),
+        "w2": jax.random.normal(k3, (n, n), jnp.float32),
+        "y1": jnp.zeros((n, n), jnp.float32),
+        "y2": jnp.zeros((n, n), jnp.float32),
+        "z": jnp.zeros((n, n), jnp.float32),
+    }
+
+
+def expected(bufs):
+    return bufs["x"] @ bufs["w1"] + bufs["x"] @ bufs["w2"]
+
+
+def test_every_searched_schedule_computes_the_same_answer():
+    g = diamond_graph()
+    plat = Platform.make_n_lanes(2)
+    bufs = make_bufs()
+    ex = TraceExecutor(plat, bufs)
+    states = get_all_sequences(g, plat, max_seqs=50)
+    assert len(states) >= 2
+    want = expected(bufs)
+    for st in states:
+        out = ex.run(st.sequence)
+        np.testing.assert_allclose(np.asarray(out["z"]), np.asarray(want), rtol=1e-5)
+
+
+def test_lowered_hlo_contains_barrier_chains():
+    g = diamond_graph()
+    plat = Platform.make_n_lanes(2)
+    bufs = make_bufs()
+    ex = TraceExecutor(plat, bufs)
+    st = get_all_sequences(g, plat, max_seqs=1)[0]
+    txt = ex.lowered_text(st.sequence)
+    assert "opt-barrier" in txt or "OptimizationBarrier" in txt or "optimization_barrier" in txt
+
+
+def test_compile_cache_hits():
+    g = diamond_graph()
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, make_bufs())
+    st = get_all_sequences(g, plat, max_seqs=1)[0]
+    f1 = ex.compile(st.sequence)
+    f2 = ex.compile(st.sequence)
+    assert f1 is f2
+
+
+def test_undeclared_buffer_write_raises():
+    class Rogue(DeviceOp):
+        def apply(self, bufs, ctx):
+            return {"ghost": jnp.zeros(())}
+
+    g = Graph()
+    g.start_then(Rogue("r"))
+    g.then_finish(Rogue("r"))
+    plat = Platform.make_n_lanes(1)
+    ex = TraceExecutor(plat, {"x": jnp.zeros((2,))})
+    st = get_all_sequences(g, plat, max_seqs=1)[0]
+    with pytest.raises(KeyError, match="undeclared"):
+        ex.run(st.sequence)
+
+
+class Shift(DeviceOp):
+    """ppermute ring shift over mesh axis 'd' — an ICI comm op."""
+
+    def reads(self):
+        return ["v"]
+
+    def writes(self):
+        return ["v"]
+
+    def apply(self, bufs, ctx):
+        n = jax.lax.axis_size("d")
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return {"v": jax.lax.ppermute(bufs["v"], "d", perm)}
+
+
+def test_mesh_sharded_schedule_with_collective():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("d",))
+    plat = Platform.make_n_lanes(2, mesh=mesh, specs={"v": P("d")})
+    bufs = {"v": jnp.arange(8, dtype=jnp.float32)}
+    g = Graph()
+    g.start_then(Shift("shift"))
+    g.then_finish(Shift("shift"))
+    ex = TraceExecutor(plat, bufs)
+    st = get_all_sequences(g, plat, max_seqs=1)[0]
+    out = ex.run(st.sequence)
+    np.testing.assert_array_equal(np.asarray(out["v"]), np.roll(np.arange(8.0), 1))
+
+
+def test_empirical_benchmarker_smoke():
+    g = diamond_graph()
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, make_bufs())
+    bench = EmpiricalBenchmarker(ex)
+    st = get_all_sequences(g, plat, max_seqs=1)[0]
+    res = bench.benchmark(st.sequence, BenchOpts(n_iters=5, target_secs=0.001))
+    assert res.pct50 > 0.0
+    assert res.pct01 <= res.pct50 <= res.pct99

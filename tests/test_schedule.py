@@ -153,6 +153,25 @@ def test_rule4_wait_advances_lane_point():
     assert any(op.event() == Event(9) for op in evs)
 
 
+def test_rule5_requires_effective_cover():
+    # e1 waited at a point where e2 is NOT yet recorded: e0's pair must survive
+    seq = Sequence(
+        [
+            Start(),
+            k("a", 0),
+            EventRecord(Lane(0), Event(0)),
+            WaitEvent(Lane(1), Event(1)),  # e1 not recorded yet -> ineffective
+            k("a2", 0),
+            EventRecord(Lane(0), Event(1)),
+            WaitEvent(Lane(1), Event(0)),
+            k("b", 1),
+        ]
+    )
+    out = remove_redundant_syncs(seq)
+    waits = [op for op in out if isinstance(op, WaitEvent)]
+    assert any(w.event() == Event(0) for w in waits), "load-bearing wait dropped"
+
+
 def test_make_schedules_enumerates_topological_orders():
     g = Graph()
     a, b = NoOp("a"), NoOp("b")

@@ -1,0 +1,112 @@
+"""SpMV workload: data structures, split, compound graph, end-to-end numerics
+(reference test/test_expand_spmv.cu:16-51 and the C12 data layer)."""
+
+import numpy as np
+import pytest
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.core.state import State
+from tenzing_tpu.models.spmv import (
+    CooMat,
+    CsrMat,
+    SpMVCompound,
+    make_spmv_buffers,
+    part_by_rows,
+    get_owner,
+    random_band_matrix,
+    random_matrix,
+    split_local_remote,
+)
+from tenzing_tpu.runtime.executor import TraceExecutor
+from tenzing_tpu.solve.dfs import get_all_sequences
+
+
+def test_coo_to_csr_roundtrip():
+    coo = CooMat(
+        3,
+        3,
+        np.array([2, 0, 0]),
+        np.array([1, 0, 2]),
+        np.array([5.0, 1.0, 2.0], dtype=np.float32),
+    )
+    csr = coo.to_csr()
+    dense = csr.toarray()
+    want = np.zeros((3, 3), dtype=np.float32)
+    want[2, 1], want[0, 0], want[0, 2] = 5.0, 1.0, 2.0
+    np.testing.assert_array_equal(dense, want)
+
+
+def test_band_matrix_stays_in_band():
+    m, bw = 100, 5
+    a = random_band_matrix(m, bw, 500, seed=1)
+    for i in range(m):
+        for j in range(a.indptr[i], a.indptr[i + 1]):
+            assert abs(int(a.cols[j]) - i) <= bw
+
+
+def test_slab_spmv_matches_dense():
+    a = random_matrix(50, 40, 300, seed=2)
+    vals, cols = a.to_slab()
+    x = np.random.default_rng(0).random(40, dtype=np.float32)
+    y = np.sum(vals * x[cols], axis=1)
+    np.testing.assert_allclose(y, a.toarray() @ x, rtol=1e-5)
+
+
+def test_slab_width_truncation_rejected():
+    a = random_matrix(50, 40, 300, seed=2)
+    with pytest.raises(ValueError, match="truncate"):
+        a.to_slab(width=1)
+
+
+def test_retain_rows():
+    a = random_matrix(20, 20, 100, seed=3)
+    sub = a.retain_rows(5, 12)
+    np.testing.assert_allclose(sub.toarray(), a.toarray()[5:12], rtol=1e-6)
+
+
+def test_partition():
+    assert part_by_rows(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert get_owner(10, 3, 0) == 0
+    assert get_owner(10, 3, 5) == 1
+    assert get_owner(10, 3, 9) == 2
+
+
+def test_split_local_remote_reassembles():
+    a = random_matrix(30, 30, 200, seed=4)
+    sp = split_local_remote(a, 0, 15)
+    x = np.random.default_rng(1).random(30, dtype=np.float32)
+    y_loc = sp.local.toarray() @ x[:15]
+    y_rem = sp.remote.toarray() @ x[sp.remote_cols]
+    np.testing.assert_allclose(y_loc + y_rem, a.toarray() @ x, rtol=1e-4)
+    # remote columns are all outside the local range
+    assert all(c >= 15 for c in sp.remote_cols)
+
+
+def test_spmv_compound_expansion():
+    # reference test_expand_spmv.cu: ExpandOp yields the compound's interior
+    g = Graph()
+    comp = SpMVCompound()
+    g.start_then(comp)
+    g.then_finish(comp)
+    plat = Platform.make_n_lanes(2)
+    s = State(g)
+    ds = s.get_decisions(plat)
+    assert len(ds) == 1 and "Expand" in ds[0].desc()
+    s2 = s.apply(ds[0])
+    names = {op.name() for op in s2.graph.vertices()}
+    assert {"spmv_local", "scatter", "exchange", "spmv_remote", "y_add"} <= names
+
+
+def test_spmv_end_to_end_all_schedules_correct():
+    bufs, want = make_spmv_buffers(m=256, nnz_per_row=4, seed=0)
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, bufs)
+    states = get_all_sequences(g, plat, max_seqs=8)
+    assert states
+    for st in states:
+        out = ex.run(st.sequence)
+        np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=2e-3)
