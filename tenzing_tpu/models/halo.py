@@ -1,0 +1,285 @@
+"""3D halo exchange over a device mesh: the spatial-decomposition workload.
+
+Parity target: reference ``src/halo_exchange`` + ``include/tenzing/halo_exchange``
+(C11 in SURVEY.md §2): a ``nX x nY x nZ x nQ`` grid with ghost radius ``r`` is
+decomposed over ranks; per face direction the DAG is
+Pack(GpuOp) -> Isend -> wait, Irecv -> Wait -> Unpack(GpuOp)
+(``HaloExchange::add_to_graph``, ops_halo_exchange.cu:33-257), with pack/unpack
+CUDA kernels per storage order (ops_halo_exchange.cu:519-699) and periodic
+rank-coordinate wrap (halo_run_strategy.hpp:80-98).
+
+TPU-native redesign: the grid (with ghost shells) is sharded over a 3D device
+mesh ``("x", "y", "z")``; per direction the DAG is
+Pack(slice of the interior edge) -> Exchange(``lax.ppermute`` along the face's
+mesh axis, periodic) -> Unpack(``dynamic_update_slice`` into the ghost shell).
+Pack/unpack are XLA slice ops (contiguous copies the compiler fuses; the
+reference needs hand-written CUDA kernels for exactly this).  The six directions
+are independent in the graph, so the solver searches how exchanges overlap each
+other — the reference's post-all-before-wait-any discipline becomes one more
+region of the schedule space rather than a hard-coded edge set.
+
+SSA note: the six Unpacks all write ``U``, so within one schedule they chain
+through the buffer's SSA versions in sequence order (disjoint ghost regions, so
+any order is numerically identical); pack/exchange stages overlap freely.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+
+# the six face directions (reference loops dx,dy,dz with exactly_one,
+# ops_halo_exchange.cu:29-31,57-144)
+DIRECTIONS: List[Tuple[int, int, int]] = [
+    (1, 0, 0),
+    (-1, 0, 0),
+    (0, 1, 0),
+    (0, -1, 0),
+    (0, 0, 1),
+    (0, 0, -1),
+]
+
+_AXIS_NAMES = ("x", "y", "z")
+
+
+def dir_name(d: Tuple[int, int, int]) -> str:
+    """'px'/'mx'/'py'/... (the reference's dir_to_tag analog,
+    ops_halo_exchange.cu:16-27)."""
+    for i, v in enumerate(d):
+        if v != 0:
+            return ("p" if v > 0 else "m") + _AXIS_NAMES[i]
+    raise ValueError(d)
+
+
+@dataclass(frozen=True)
+class HaloArgs:
+    """Per-shard grid extents (reference HaloExchange::Args,
+    ops_halo_exchange.hpp:33-55; rank coords come from the mesh, not lambdas)."""
+
+    nq: int = 3
+    lx: int = 64
+    ly: int = 64
+    lz: int = 64
+    radius: int = 3
+
+    def local_shape(self) -> Tuple[int, int, int, int]:
+        r = self.radius
+        return (self.nq, self.lx + 2 * r, self.ly + 2 * r, self.lz + 2 * r)
+
+
+def _face_slices(args: HaloArgs, d: Tuple[int, int, int], which: str):
+    """Start indices + sizes of the face region along direction ``d``:
+    ``which`` = 'pack' (interior edge) or 'unpack' (ghost shell)."""
+    r = args.radius
+    ext = [args.lx, args.ly, args.lz]
+    starts = [0, r, r, r]
+    sizes = [args.nq, ext[0], ext[1], ext[2]]
+    for i, v in enumerate(d):
+        if v == 0:
+            continue
+        sizes[1 + i] = r
+        if which == "pack":
+            # the interior edge facing the neighbor
+            starts[1 + i] = ext[i] if v > 0 else r
+        else:
+            # the ghost shell on the OPPOSITE side (data arrives from -d)
+            starts[1 + i] = 0 if v > 0 else ext[i] + r
+    return starts, sizes
+
+
+class Pack(DeviceOp):
+    """Slice the interior edge for one direction (reference Pack,
+    ops_halo_exchange.hpp:97-141, kernels ops_halo_exchange.cu:519-573)."""
+
+    def __init__(self, args: HaloArgs, d: Tuple[int, int, int]):
+        super().__init__(f"pack_{dir_name(d)}")
+        self._args, self._d = args, d
+
+    def reads(self):
+        return ["U"]
+
+    def writes(self):
+        return [f"buf_{dir_name(self._d)}"]
+
+    def apply(self, bufs, ctx):
+        import jax.lax as lax
+
+        starts, sizes = _face_slices(self._args, self._d, "pack")
+        sl = lax.dynamic_slice(bufs["U"], starts, sizes)
+        return {f"buf_{dir_name(self._d)}": sl}
+
+
+class Exchange(DeviceOp):
+    """Periodic neighbor permute along the direction's mesh axis (the Isend +
+    Irecv + waits of the reference, ops_mpi.hpp:17-146, collapsed into one ICI
+    collective)."""
+
+    def __init__(self, d: Tuple[int, int, int]):
+        super().__init__(f"exchange_{dir_name(d)}")
+        self._d = d
+
+    def reads(self):
+        return [f"buf_{dir_name(self._d)}"]
+
+    def writes(self):
+        return [f"recv_{dir_name(self._d)}"]
+
+    def apply(self, bufs, ctx):
+        import jax
+
+        axis = _AXIS_NAMES[[i for i, v in enumerate(self._d) if v != 0][0]]
+        sign = sum(self._d)
+        n = jax.lax.axis_size(axis)
+        if sign > 0:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+        else:
+            perm = [(i, (i - 1) % n) for i in range(n)]
+        name = dir_name(self._d)
+        return {f"recv_{name}": jax.lax.ppermute(bufs[f"buf_{name}"], axis, perm)}
+
+
+class Unpack(DeviceOp):
+    """Write the received face into the ghost shell (reference Unpack,
+    ops_halo_exchange.hpp:143-186, kernels ops_halo_exchange.cu:611-699 — and
+    without the stray device-sync defect noted in SURVEY.md §7.3)."""
+
+    def __init__(self, args: HaloArgs, d: Tuple[int, int, int]):
+        super().__init__(f"unpack_{dir_name(d)}")
+        self._args, self._d = args, d
+
+    def reads(self):
+        return ["U", f"recv_{dir_name(self._d)}"]
+
+    def writes(self):
+        return ["U"]
+
+    def apply(self, bufs, ctx):
+        import jax.lax as lax
+
+        starts, _ = _face_slices(self._args, self._d, "unpack")
+        return {"U": lax.dynamic_update_slice(bufs["U"], bufs[f"recv_{dir_name(self._d)}"], starts)}
+
+
+class HaloExchange(CompoundOp):
+    """The whole 6-direction exchange as one compound op."""
+
+    def __init__(self, args: HaloArgs, name: str = "halo_exchange"):
+        super().__init__(name)
+        self._args = args
+
+    def graph(self) -> Graph:
+        return add_to_graph(Graph(), self._args)
+
+    def args(self) -> HaloArgs:
+        return self._args
+
+
+def add_to_graph(
+    g: Graph,
+    args: HaloArgs,
+    preds: Optional[List] = None,
+    succs: Optional[List] = None,
+) -> Graph:
+    """Build the per-direction pack -> exchange -> unpack chains (reference
+    HaloExchange::add_to_graph, ops_halo_exchange.cu:33-257)."""
+    preds = preds if preds is not None else [g.start()]
+    succs = succs if succs is not None else [g.finish()]
+    for d in DIRECTIONS:
+        pack, exch, unpack = Pack(args, d), Exchange(d), Unpack(args, d)
+        for p in preds:
+            g.then(p, pack)
+        g.then(pack, exch)
+        g.then(exch, unpack)
+        for s in succs:
+            g.then(unpack, s)
+    return g
+
+
+def make_halo_buffers(
+    mesh_shape: Tuple[int, int, int], args: HaloArgs, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
+    """(buffers, partition specs, expected U after one exchange).
+
+    The global interior grid is periodic; the expected array has every shard's
+    ghost faces filled from its periodic neighbors (edges/corners of the shells
+    stay untouched — the reference exchanges faces only)."""
+    from jax.sharding import PartitionSpec as P
+
+    mx, my, mz = mesh_shape
+    r, nq = args.radius, args.nq
+    rng = np.random.default_rng(seed)
+    # global interior
+    G = rng.random((nq, mx * args.lx, my * args.ly, mz * args.lz), dtype=np.float32)
+
+    def shard_block(i, j, k, arr=None):
+        a = G if arr is None else arr
+        return a[
+            :,
+            i * args.lx : (i + 1) * args.lx,
+            j * args.ly : (j + 1) * args.ly,
+            k * args.lz : (k + 1) * args.lz,
+        ]
+
+    # per-shard local arrays with ghost shells, interiors filled
+    locs = np.zeros((mx, my, mz) + args.local_shape(), dtype=np.float32)
+    want = np.zeros_like(locs)
+    for i in range(mx):
+        for j in range(my):
+            for k in range(mz):
+                locs[i, j, k][:, r : r + args.lx, r : r + args.ly, r : r + args.lz] = (
+                    shard_block(i, j, k)
+                )
+    want[:] = locs
+    # expected ghosts: periodic neighbor interior edges
+    for i in range(mx):
+        for j in range(my):
+            for k in range(mz):
+                w = want[i, j, k]
+                for d in DIRECTIONS:
+                    ni = ((i - d[0]) % mx, (j - d[1]) % my, (k - d[2]) % mz)
+                    nb = locs[ni]  # the shard the face arrives FROM
+                    ps, sz = _face_slices(args, d, "pack")
+                    us, _ = _face_slices(args, d, "unpack")
+                    face = nb[
+                        :,
+                        ps[1] : ps[1] + sz[1],
+                        ps[2] : ps[2] + sz[2],
+                        ps[3] : ps[3] + sz[3],
+                    ]
+                    w[
+                        :,
+                        us[1] : us[1] + sz[1],
+                        us[2] : us[2] + sz[2],
+                        us[3] : us[3] + sz[3],
+                    ] = face
+
+    def assemble(blocks):
+        """(mx,my,mz, nq, X,Y,Z) -> global (nq, mx*X, my*Y, mz*Z) layout."""
+        return np.concatenate(
+            [
+                np.concatenate(
+                    [np.concatenate(list(blocks[i, j]), axis=3) for j in range(my)],
+                    axis=2,
+                )
+                for i in range(mx)
+            ],
+            axis=1,
+        )
+
+    U = assemble(locs)
+    want_g = assemble(want)
+    bufs = {"U": U}
+    specs = {"U": P(None, "x", "y", "z")}
+    for d in DIRECTIONS:
+        _, sz = _face_slices(args, d, "pack")
+        buf = np.zeros((sz[0], mx * sz[1], my * sz[2], mz * sz[3]), dtype=np.float32)
+        bufs[f"buf_{dir_name(d)}"] = buf
+        bufs[f"recv_{dir_name(d)}"] = buf.copy()
+        specs[f"buf_{dir_name(d)}"] = P(None, "x", "y", "z")
+        specs[f"recv_{dir_name(d)}"] = P(None, "x", "y", "z")
+    return bufs, specs, want_g

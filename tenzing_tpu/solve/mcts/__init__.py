@@ -1,0 +1,2 @@
+from tenzing_tpu.solve.mcts.mcts import MctsOpts, MctsResult, explore  # noqa: F401
+from tenzing_tpu.solve.mcts.node import Node  # noqa: F401

@@ -1,0 +1,167 @@
+"""MCTS search driver.
+
+Parity target: reference ``tenzing-mcts/include/tenzing/mcts/mcts.hpp``
+``explore`` (mcts.hpp:154-327): per iteration — select (rank 0), expand, random
+rollout to a complete schedule, ``remove_redundant_syncs``, broadcast the order
+to all hosts, provision events, benchmark on every host, backprop (rank 0),
+periodic graphviz tree dump with decaying cadence (mcts.hpp:52-127,302-309),
+phase counters (counters.hpp), stop when the root is fully visited
+(mcts.hpp:194-201) — broadcast via the control plane's stop protocol.
+"""
+
+from __future__ import annotations
+
+import random as _random
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional, Type
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, result_row
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.schedule import remove_redundant_syncs
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
+from tenzing_tpu.core.state import State
+from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
+from tenzing_tpu.solve.mcts.node import Node
+from tenzing_tpu.solve.mcts.strategies import FastMin
+from tenzing_tpu.utils import trap
+from tenzing_tpu.utils.counters import Counters
+
+
+@dataclass
+class MctsOpts:
+    """reference mcts::Opts (mcts.hpp:42-50)."""
+
+    n_iters: int = 300
+    bench_opts: BenchOpts = field(default_factory=BenchOpts)
+    expand_rollout: bool = False
+    dump_tree: bool = False
+    dump_tree_prefix: str = "mcts_tree"
+    dump_csv_path: Optional[str] = None
+    seed: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "n_iters": self.n_iters,
+            "expand_rollout": self.expand_rollout,
+            "seed": self.seed,
+        }
+
+
+@dataclass
+class SimResult:
+    order: Sequence
+    result: BenchResult
+
+
+@dataclass
+class MctsResult:
+    sims: List[SimResult] = field(default_factory=list)
+    tree_size: int = 0
+    counters: Optional[Counters] = None
+
+    def dump_csv(self, path: Optional[str] = None) -> str:
+        rows = [result_row(i, s.result, s.order) for i, s in enumerate(self.sims)]
+        text = "\n".join(rows) + ("\n" if rows else "")
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def best(self) -> Optional[SimResult]:
+        if not self.sims:
+            return None
+        return min(self.sims, key=lambda s: s.result.pct10)
+
+
+def _dump_cadence(it: int) -> bool:
+    """Decaying dump cadence (reference mcts.hpp:302-309): every iteration up to
+    10, then every 10th up to 100, then every 100th."""
+    if it < 10:
+        return True
+    if it < 100:
+        return it % 10 == 0
+    return it % 100 == 0
+
+
+def explore(
+    graph: Graph,
+    platform,
+    benchmarker,
+    opts: Optional[MctsOpts] = None,
+    strategy: Optional[Type] = None,
+    control_plane: Optional[ControlPlane] = None,
+) -> MctsResult:
+    """Run the MCTS search (reference mcts::explore, mcts.hpp:154-327)."""
+    opts = opts if opts is not None else MctsOpts()
+    strategy = strategy if strategy is not None else FastMin
+    cp = control_plane if control_plane is not None else default_control_plane()
+    rng = _random.Random(opts.seed)
+    counters = Counters()
+    result = MctsResult(counters=counters)
+
+    def dump_partial():  # reference mcts.hpp:174-179
+        if opts.dump_csv_path:
+            result.dump_csv(opts.dump_csv_path)
+        else:
+            print(result.dump_csv(), end="")
+
+    trap.register_handler(dump_partial)
+    try:
+        ctx = strategy.Context(seed=opts.seed)
+        root = Node(State(graph), strategy) if cp.rank() == 0 else None
+        if root is not None:
+            ctx.root = root
+        for it in range(opts.n_iters):
+            stop = False
+            order: Optional[Sequence] = None
+            endpoint: Optional[Node] = None
+            if cp.rank() == 0:
+                assert root is not None
+                if root.fully_visited_:
+                    stop = True
+                else:
+                    with counters.phase("SELECT"):
+                        leaf = root.select(ctx, platform, rng)
+                    with counters.phase("EXPAND"):
+                        child = leaf.expand(platform, rng)
+                    with counters.phase("ROLLOUT"):
+                        endpoint, order = child.get_rollout(
+                            platform, rng, opts.expand_rollout
+                        )
+                    with counters.phase("REDUNDANT_SYNC"):
+                        order = remove_redundant_syncs(order)
+            # stop-flag + schedule broadcast (reference mcts.hpp:129-152,244)
+            with counters.phase("BCAST"):
+                stop = cp.bcast_json(stop)
+                if stop:
+                    break
+                payload = cp.bcast_json(
+                    sequence_to_json(order) if cp.rank() == 0 else None
+                )
+                if cp.rank() != 0:
+                    order = sequence_from_json(payload, graph)
+            # event provisioning (reference mcts.hpp:247-270)
+            events = []
+            for op in order:
+                if hasattr(op, "events"):
+                    events.extend(op.events())
+            platform.provision_events(events)
+            with counters.phase("BENCHMARK"):
+                res = benchmarker.benchmark(order, opts.bench_opts)
+            result.sims.append(SimResult(order=order, result=res))
+            if cp.rank() == 0:
+                with counters.phase("BACKPROP"):
+                    endpoint.backprop(ctx, res)
+                if opts.dump_tree and _dump_cadence(it):
+                    path = f"{opts.dump_tree_prefix}_{it:06d}.dot"
+                    with open(path, "w") as f:
+                        f.write(root.dump_graphviz())
+        if cp.rank() == 0 and root is not None:
+            result.tree_size = root.size()
+        if opts.dump_csv_path and cp.rank() == 0:
+            result.dump_csv(opts.dump_csv_path)
+        return result
+    finally:
+        trap.unregister_handler(dump_partial)

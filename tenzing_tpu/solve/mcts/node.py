@@ -1,0 +1,174 @@
+"""MCTS tree node.
+
+Parity target: reference ``tenzing-mcts/include/tenzing/mcts/mcts_node.hpp``:
+``Node<Strategy>`` holds parent/children, the decision that produced it, its own
+graph snapshot (graph-mutating decisions change the graph down the subtree,
+mcts_node.hpp:25-106), rollout count ``n_``, ``fullyVisited_``, and per-node
+strategy state.  ``select`` is UCT descent with the strategy's exploitation term
+(mcts_node.hpp:168-240); ``expand`` returns the first unplayed child
+(mcts_node.hpp:352-369); ``get_rollout`` descends randomly to a terminal state
+(mcts_node.hpp:371-446); ``backprop`` bumps counts, propagates fully-visited, and
+calls the strategy up the chain (mcts_node.hpp:326-350).
+
+Simplification vs the reference: each node stores its full SDP ``State``
+(graph + sequence) rather than reconstructing the state from the root path —
+clone surgery shares op objects so snapshots are cheap; the C++ core will restore
+the path-reconstruction optimization if profiles demand it.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import List, Optional, Tuple
+
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.state import Decision, ExecuteOp, State
+
+
+class Node:
+    def __init__(
+        self,
+        state: State,
+        strategy,
+        decision: Optional[Decision] = None,
+        parent: Optional["Node"] = None,
+    ):
+        self.state = state
+        self.strategy = strategy
+        self.decision = decision
+        self.parent = parent
+        self.children: List["Node"] = []
+        self.n_ = 0  # rollouts through this node (reference n_)
+        self.fully_visited_ = False
+        self.expanded_ = False
+        self.strat_state = strategy.State()  # per-node observations
+
+    # -- structure ---------------------------------------------------------
+    def is_terminal(self) -> bool:
+        return self.state.is_terminal()
+
+    def label(self) -> str:
+        return self.decision.desc() if self.decision is not None else "root"
+
+    def ensure_children(self, platform) -> None:
+        """Create one child per decision (reference create_children,
+        mcts_node.hpp:514-552); Execute decisions become op nodes, graph-only
+        decisions become decision nodes — both are plain children here."""
+        if self.expanded_ or self.is_terminal():
+            self.expanded_ = True
+            return
+        for d in self.state.get_decisions(platform):
+            self.children.append(Node(self.state.apply(d), self.strategy, d, self))
+        self.expanded_ = True
+        if not self.children:
+            self.fully_visited_ = True
+
+    # -- selection (reference mcts_node.hpp:168-240) ------------------------
+    def select(self, ctx, platform, rng: random.Random) -> "Node":
+        """UCT descent: walk down while fully expanded, maximizing
+        exploit + sqrt(2)*sqrt(ln n_parent / n_child); fully-visited children
+        score -inf; ties break randomly."""
+        node = self
+        while True:
+            node.ensure_children(platform)
+            if node.is_terminal() or not node.children:
+                return node
+            unplayed = [c for c in node.children if c.n_ == 0]
+            if unplayed:
+                return node
+            best_score = -math.inf
+            best: List[Node] = []
+            for c in node.children:
+                if c.fully_visited_:
+                    continue
+                exploit = self.strategy.select(ctx, c)
+                explore = math.sqrt(2.0) * math.sqrt(math.log(node.n_) / c.n_)
+                score = exploit + explore
+                if score > best_score:
+                    best_score, best = score, [c]
+                elif score == best_score:
+                    best.append(c)
+            if not best:
+                return node  # all children fully visited
+            node = rng.choice(best)
+
+    def expand(self, platform, rng: random.Random) -> "Node":
+        """First unplayed child, or self when terminal (reference
+        mcts_node.hpp:352-369)."""
+        self.ensure_children(platform)
+        unplayed = [c for c in self.children if c.n_ == 0]
+        if unplayed:
+            return rng.choice(unplayed)
+        return self
+
+    # -- rollout (reference mcts_node.hpp:371-446) ---------------------------
+    def get_rollout(
+        self, platform, rng: random.Random, expand_rollout: bool = False
+    ) -> Tuple["Node", Sequence]:
+        """Random descent to a terminal state; returns (backprop endpoint, the
+        complete schedule).  Without ``expand_rollout`` the playout runs on
+        throwaway State objects and the endpoint is this node (reference
+        mcts_node.hpp:371-446, backpropStart = this); with it, the visited path
+        is materialized as tree nodes and the endpoint is the terminal node."""
+        if expand_rollout:
+            node: Node = self
+            while not node.is_terminal():
+                node.ensure_children(platform)
+                if not node.children:
+                    break
+                node = rng.choice(node.children)
+            return node, node.state.sequence
+        state = self.state
+        while not state.is_terminal():
+            ds = state.get_decisions(platform)
+            if not ds:
+                break
+            state = state.apply(rng.choice(ds))
+        return self, state.sequence
+
+    # -- backprop (reference mcts_node.hpp:326-350) --------------------------
+    def backprop(self, ctx, result) -> None:
+        node: Optional[Node] = self
+        while node is not None:
+            node.n_ += 1
+            self.strategy.backprop(ctx, node, result)
+            if node.is_terminal():
+                node.fully_visited_ = True
+            elif node.expanded_ and node.children and all(
+                c.fully_visited_ for c in node.children
+            ):
+                node.fully_visited_ = True
+            node = node.parent
+
+    # -- introspection ------------------------------------------------------
+    def size(self) -> int:
+        return 1 + sum(c.size() for c in self.children)
+
+    def dump_graphviz(self, max_nodes: int = 500) -> str:
+        """Tree dump with rollout counts (reference dump_graphviz,
+        mcts.hpp:52-127)."""
+        lines = ["digraph mcts {"]
+        count = [0]
+
+        def walk(node: Node, nid: int) -> int:
+            my = nid
+            lines.append(
+                f'  n{my} [label="{node.label()}\\nn={node.n_}'
+                + ("\\nfull" if node.fully_visited_ else "")
+                + '"];'
+            )
+            nxt = my + 1
+            for c in node.children:
+                if count[0] >= max_nodes:
+                    break
+                if c.n_ == 0:
+                    continue
+                count[0] += 1
+                lines.append(f"  n{my} -> n{nxt};")
+                nxt = walk(c, nxt)
+            return nxt
+
+        walk(self, 0)
+        lines.append("}")
+        return "\n".join(lines) + "\n"

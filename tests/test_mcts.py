@@ -1,0 +1,130 @@
+"""MCTS solver + strategies (reference tenzing-mcts/ mcts_node.hpp, mcts.hpp,
+strategy headers)."""
+
+import pytest
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import BoundDeviceOp, DeviceOp, NoOp
+from tenzing_tpu.core.resources import Lane
+from tenzing_tpu.solve.mcts import MctsOpts, explore
+from tenzing_tpu.solve.mcts.strategies import ALL_STRATEGIES, FastMin, Random
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+class FakePlatform:
+    def __init__(self, n):
+        self.lanes = [Lane(i) for i in range(n)]
+
+    def provision_events(self, events):
+        return None
+
+
+class OverlapRewardBench:
+    """Schedules using both lanes are 'faster' — a deterministic stand-in for
+    real hardware overlap."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def benchmark(self, order, opts=None):
+        self.calls += 1
+        lanes = {
+            op.lane().id for op in order if isinstance(op, BoundDeviceOp)
+        }
+        t = 1.0 if len(lanes) > 1 else 2.0
+        return BenchResult(t, t, t, t, t, 0.0)
+
+
+def two_indep_device_graph():
+    g = Graph()
+    a, b = KOp("a"), KOp("b")
+    g.start_then(a)
+    g.start_then(b)
+    g.then_finish(a)
+    g.then_finish(b)
+    return g
+
+
+def test_mcts_finds_overlapped_schedule():
+    g = two_indep_device_graph()
+    bench = OverlapRewardBench()
+    res = explore(
+        g,
+        FakePlatform(2),
+        bench,
+        MctsOpts(n_iters=64, seed=1),
+        strategy=FastMin,
+    )
+    assert res.sims
+    best = res.best()
+    assert best.result.pct10 == 1.0
+    lanes = {op.lane().id for op in best.order if isinstance(op, BoundDeviceOp)}
+    assert len(lanes) == 2
+
+
+def test_mcts_stops_when_space_exhausted():
+    # one NoOp: the whole space is a single schedule
+    g = Graph()
+    g.start_then(NoOp("x"))
+    g.then_finish(NoOp("x"))
+    bench = OverlapRewardBench()
+    res = explore(g, FakePlatform(1), bench, MctsOpts(n_iters=500, seed=0))
+    assert bench.calls < 500  # stopped early on fully-visited root
+    assert res.tree_size >= 1
+
+
+def test_mcts_seeded_deterministic():
+    g = two_indep_device_graph()
+    r1 = explore(g, FakePlatform(2), OverlapRewardBench(), MctsOpts(n_iters=16, seed=7))
+    r2 = explore(g, FakePlatform(2), OverlapRewardBench(), MctsOpts(n_iters=16, seed=7))
+    assert [s.order.desc() for s in r1.sims] == [s.order.desc() for s in r2.sims]
+
+
+@pytest.mark.parametrize("name", sorted(ALL_STRATEGIES))
+def test_every_strategy_runs(name):
+    g = two_indep_device_graph()
+    res = explore(
+        g,
+        FakePlatform(2),
+        OverlapRewardBench(),
+        MctsOpts(n_iters=12, seed=3),
+        strategy=ALL_STRATEGIES[name],
+    )
+    assert res.sims and res.best() is not None
+
+
+def test_tree_dump_and_counters(tmp_path):
+    g = two_indep_device_graph()
+    opts = MctsOpts(
+        n_iters=8,
+        seed=0,
+        dump_tree=True,
+        dump_tree_prefix=str(tmp_path / "tree"),
+        dump_csv_path=str(tmp_path / "mcts.csv"),
+    )
+    res = explore(g, FakePlatform(2), OverlapRewardBench(), opts)
+    dots = list(tmp_path.glob("tree_*.dot"))
+    assert dots
+    assert "digraph mcts" in dots[0].read_text()
+    assert (tmp_path / "mcts.csv").read_text().strip()
+    assert res.counters is not None and "SELECT" in res.counters.seconds
+    assert res.counters.report().startswith("phase counters:")
+
+
+def test_expand_rollout_materializes_tree():
+    g = two_indep_device_graph()
+    r_noexp = explore(
+        g, FakePlatform(2), OverlapRewardBench(), MctsOpts(n_iters=10, seed=2)
+    )
+    r_exp = explore(
+        g,
+        FakePlatform(2),
+        OverlapRewardBench(),
+        MctsOpts(n_iters=10, seed=2, expand_rollout=True),
+    )
+    assert r_exp.tree_size >= r_noexp.tree_size
