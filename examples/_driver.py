@@ -1,0 +1,68 @@
+"""Shared CLI driver for the example programs.
+
+Parity target: reference ``tenzing-mcts/examples/halo_run_strategy.hpp`` /
+``spmv_run_strategy.cuh`` — argparse CLI, init + reproduce stamp, graph build,
+platform, solver run, pipe-delimited CSV to stdout.  One parametrized driver with
+``--strategy`` replaces the reference's one-main-per-(workload x strategy) because
+strategies are runtime values here, not template parameters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def add_common_args(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--benchmark-iters", type=int, default=50,
+                    help="measurements per schedule (reference bench nIters=50)")
+    ap.add_argument("--lanes", type=int, default=2, help="virtual lanes (streams)")
+    ap.add_argument("--dump-csv", default=None, help="also write results to this path")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU backend with 8 virtual devices (testing)")
+    ap.add_argument("--seed", type=int, default=0)
+
+
+def add_mcts_args(ap: argparse.ArgumentParser) -> None:
+    from tenzing_tpu.solve.mcts import strategies
+
+    ap.add_argument("--mcts-iters", type=int, default=300,
+                    help="search iterations (reference spmv_run_strategy.cuh:125)")
+    ap.add_argument("--strategy", default="FastMin",
+                    choices=[s for s in dir(strategies)
+                             if isinstance(getattr(strategies, s), type)
+                             and issubclass(getattr(strategies, s), strategies.StrategyBase)
+                             and s not in ("StrategyBase", "_SiblingNormalized")])
+    ap.add_argument("--no-expand-rollout", action="store_true",
+                    help="do not materialize rollout paths in the tree")
+    ap.add_argument("--dump-tree", action="store_true",
+                    help="periodic graphviz dumps of the search tree")
+
+
+def setup(args) -> None:
+    """Backend forcing + init gate + reproduce stamp (reference drivers call
+    tenzing::init + reproduce::dump_with_cli first, halo_run_strategy.hpp:23-27)."""
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from tenzing_tpu.utils import initgate, reproduce
+
+    initgate.init()
+    reproduce.dump_with_cli()
+
+
+def emit(result, dump_csv_path=None) -> None:
+    """Pipe-delimited rows to stdout (reference CSV dump), best to stderr."""
+    text = result.dump_csv(dump_csv_path)
+    sys.stdout.write(text)
+    best = result.best()
+    if best is not None:
+        sys.stderr.write(
+            f"best: pct10={best.result.pct10 * 1e6:.2f}us "
+            f"pct50={best.result.pct50 * 1e6:.2f}us over {len(result.sims)} schedules\n"
+        )

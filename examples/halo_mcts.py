@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""MCTS schedule search on the 3D halo exchange over a device mesh.
+
+Parity target: reference ``tenzing-mcts/examples/halo_{min_time,coverage,
+anticorr,balance}.cu`` via ``halo_run_strategy.hpp`` (nQ=3, 512^3 cells/rank,
+nGhost=3, 2 streams; rank grid from prime factorization of world size) — here
+the device grid is a 3D JAX mesh, factorized the same way, and ``--strategy``
+selects the search strategy.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples import _driver
+
+
+def mesh_shape_for(n: int):
+    """Near-cubic 3D factorization of the device count (reference
+    halo_run_strategy.hpp:80-98 prime-factor rank grid)."""
+    from tenzing_tpu.utils.numeric import prime_factors
+
+    dims = [1, 1, 1]
+    for f in sorted(prime_factors(n), reverse=True):
+        dims[dims.index(min(dims))] *= f
+    return tuple(sorted(dims, reverse=True))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    _driver.add_common_args(ap)
+    _driver.add_mcts_args(ap)
+    ap.add_argument("--nq", type=int, default=3)
+    ap.add_argument("--cells", type=int, default=512,
+                    help="cells per shard per axis (reference 512)")
+    ap.add_argument("--radius", type=int, default=3, help="ghost radius")
+    args = ap.parse_args()
+    _driver.setup(args)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.halo import HaloArgs, HaloExchange, make_halo_buffers
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
+
+    devs = jax.devices()
+    mx, my, mz = mesh_shape_for(len(devs))
+    mesh = Mesh(np.array(devs).reshape(mx, my, mz), ("x", "y", "z"))
+    hargs = HaloArgs(nq=args.nq, lx=args.cells, ly=args.cells, lz=args.cells,
+                     radius=args.radius)
+    bufs, specs, _ = make_halo_buffers((mx, my, mz), hargs, seed=args.seed)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    g = Graph()
+    he = HaloExchange(hargs)
+    g.start_then(he)
+    g.then_finish(he)
+    plat = Platform.make_n_lanes(args.lanes, mesh=mesh, specs=specs)
+    bench = EmpiricalBenchmarker(TraceExecutor(plat, bufs))
+    res = explore(
+        g,
+        plat,
+        bench,
+        MctsOpts(
+            n_iters=args.mcts_iters,
+            bench_opts=BenchOpts(n_iters=args.benchmark_iters),
+            expand_rollout=not args.no_expand_rollout,
+            dump_tree=args.dump_tree,
+            seed=args.seed,
+        ),
+        strategy=getattr(strategies, args.strategy),
+    )
+    _driver.emit(res, args.dump_csv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Exhaustive DFS schedule enumeration on the SpMV iteration DAG.
+
+Parity target: reference ``tenzing-dfs/examples/spmv.cu`` (maxSeqs=15000 cap,
+band matrix, benchmark every deduplicated complete schedule).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples import _driver
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    _driver.add_common_args(ap)
+    ap.add_argument("--matrix-m", type=int, default=150_000)
+    ap.add_argument("--nnz-per-row", type=int, default=10)
+    ap.add_argument("--max-seqs", type=int, default=15_000,
+                    help="enumeration cap (reference spmv.cu:117)")
+    args = ap.parse_args()
+    _driver.setup(args)
+
+    import jax.numpy as jnp
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.dfs import DfsOpts, explore
+
+    bufs, _ = make_spmv_buffers(m=args.matrix_m, nnz_per_row=args.nnz_per_row, seed=args.seed)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    plat = Platform.make_n_lanes(args.lanes)
+    bench = EmpiricalBenchmarker(TraceExecutor(plat, bufs))
+    res = explore(
+        g, plat, bench,
+        DfsOpts(max_seqs=args.max_seqs, bench_opts=BenchOpts(n_iters=args.benchmark_iters)),
+    )
+    _driver.emit(res, args.dump_csv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

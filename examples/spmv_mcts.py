@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""MCTS schedule search on the single-host SpMV iteration DAG.
+
+Parity target: reference ``tenzing-mcts/examples/spmv_{min_time,coverage,random}.cu``
+via ``spmv_run_strategy.cuh`` (band matrix m=150000, nnz=10m, 2 streams, MCTS
+nIters=300) — here one driver parametrized by ``--strategy``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples import _driver
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    _driver.add_common_args(ap)
+    _driver.add_mcts_args(ap)
+    ap.add_argument("--matrix-m", type=int, default=150_000,
+                    help="rows (reference spmv_run_strategy.cuh:44)")
+    ap.add_argument("--nnz-per-row", type=int, default=10)
+    args = ap.parse_args()
+    _driver.setup(args)
+
+    import jax.numpy as jnp
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
+
+    bufs, _ = make_spmv_buffers(m=args.matrix_m, nnz_per_row=args.nnz_per_row, seed=args.seed)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    plat = Platform.make_n_lanes(args.lanes)
+    bench = EmpiricalBenchmarker(TraceExecutor(plat, bufs))
+    res = explore(
+        g,
+        plat,
+        bench,
+        MctsOpts(
+            n_iters=args.mcts_iters,
+            bench_opts=BenchOpts(n_iters=args.benchmark_iters),
+            expand_rollout=not args.no_expand_rollout,
+            dump_tree=args.dump_tree,
+            seed=args.seed,
+        ),
+        strategy=getattr(strategies, args.strategy),
+    )
+    _driver.emit(res, args.dump_csv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Offline analysis of solver result databases: performance classes + design rules.
+
+Parity target: reference ``postprocess/postprocess.py:27-120`` — sort schedules by
+10th-percentile time, locate performance-class boundaries by convolving with a step
+function and finding peaks, then fit a decision tree over schedule features to
+extract human-readable rules for why some schedules are fast.
+
+Input: the pipe-delimited rows dumped by the solvers
+(``idx|pct01|pct10|pct50|pct90|pct99|stddev|op-json|op-json|...``,
+tenzing_tpu/bench/benchmarker.py result_row — same shape as reference
+mcts.cpp:13-31 / dfs.cpp:84-105).
+
+Schedule features (the TPU analog of the reference's stream-assignment features):
+  * ``lane:<op>=<k>``  — device op <op> is bound to lane k
+  * ``before:<a><b``   — op a precedes op b in the total order
+The decision-tree rules are printed as indented if/else text.
+
+Usage: python postprocess/postprocess.py results.csv [--max-depth 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+DELIM = "|"
+
+
+def load_rows(text: str) -> List[dict]:
+    """Parse result rows into {times: {...}, ops: [op-json dicts]}."""
+    out = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        cells = line.split(DELIM)
+        times = {
+            "pct01": float(cells[1]),
+            "pct10": float(cells[2]),
+            "pct50": float(cells[3]),
+            "pct90": float(cells[4]),
+            "pct99": float(cells[5]),
+            "stddev": float(cells[6]),
+        }
+        ops = [json.loads(c) for c in cells[7:]]
+        out.append({"times": times, "ops": ops})
+    return out
+
+
+def class_boundaries(sorted_times: np.ndarray, rel_height: float = 0.05) -> List[int]:
+    """Indices where the sorted time curve steps up: convolve with a step kernel
+    and take peaks (reference postprocess.py class-boundary detection)."""
+    from scipy.signal import find_peaks
+
+    n = len(sorted_times)
+    if n < 4:
+        return []
+    k = max(2, n // 50)
+    # step response at i: mean of the k times at/after i minus mean of the k before
+    resp = np.zeros(n)
+    for i in range(k, n - k + 1):
+        resp[i] = sorted_times[i : i + k].mean() - sorted_times[i - k : i].mean()
+    span = float(sorted_times[-1] - sorted_times[0])
+    if span <= 0:
+        return []
+    peaks, _ = find_peaks(resp, height=rel_height * span)
+    return [int(p) for p in peaks]
+
+
+def schedule_features(rows: List[dict]) -> Tuple[np.ndarray, List[str]]:
+    """Binary/ordinal feature matrix over lane assignments and pairwise order."""
+    # collect device-op names (those serialized with a lane binding)
+    lane_ops: List[str] = []
+    all_names: List[str] = []
+    seen = set()
+    for r in rows:
+        for op in r["ops"]:
+            # scheduler-inserted sync ops carry no name; they are per-schedule
+            # artifacts, not design features
+            if "name" not in op:
+                continue
+            name = op["name"]
+            if name not in seen:
+                seen.add(name)
+                all_names.append(name)
+                if "lane" in op:
+                    lane_ops.append(name)
+    feats: List[str] = [f"lane:{n}" for n in lane_ops]
+    pairs = [
+        (a, b) for i, a in enumerate(all_names) for b in all_names[i + 1 :]
+        if not (a.startswith(("start", "finish")) or b.startswith(("start", "finish")))
+    ]
+    feats += [f"before:{a}<{b}" for a, b in pairs]
+    X = np.zeros((len(rows), len(feats)), dtype=np.float32)
+    for ri, r in enumerate(rows):
+        pos = {}
+        for i, op in enumerate(r["ops"]):
+            if "name" not in op:
+                continue
+            name = op["name"]
+            pos.setdefault(name, i)
+            if "lane" in op and name in lane_ops:
+                X[ri, lane_ops.index(name)] = float(op["lane"])
+        for pi, (a, b) in enumerate(pairs):
+            if a in pos and b in pos:
+                X[ri, len(lane_ops) + pi] = 1.0 if pos[a] < pos[b] else 0.0
+    return X, feats
+
+
+def fit_rules(X: np.ndarray, classes: np.ndarray, feats: List[str], max_depth: int = 3) -> str:
+    """Decision tree over schedule features -> indented rule text (reference
+    postprocess.py sklearn tree fit + export)."""
+    from sklearn.tree import DecisionTreeClassifier, export_text
+
+    clf = DecisionTreeClassifier(max_depth=max_depth, random_state=0)
+    clf.fit(X, classes)
+    return export_text(clf, feature_names=feats)
+
+
+def analyze(text: str, max_depth: int = 3, stream=None) -> dict:
+    stream = stream or sys.stdout
+    rows = load_rows(text)
+    if not rows:
+        stream.write("no rows\n")
+        return {"n": 0}
+    times = np.array([r["times"]["pct10"] for r in rows])
+    order = np.argsort(times)
+    sorted_times = times[order]
+    bounds = class_boundaries(sorted_times)
+    # class id per schedule: how many boundaries its sorted rank passes
+    ranks = np.empty(len(rows), dtype=int)
+    ranks[order] = np.arange(len(rows))
+    classes = np.zeros(len(rows), dtype=int)
+    for b in bounds:
+        classes += (ranks >= b).astype(int)
+    stream.write(
+        f"{len(rows)} schedules, pct10 range [{sorted_times[0]:.3e}, "
+        f"{sorted_times[-1]:.3e}] s, {len(bounds) + 1} performance classes\n"
+    )
+    for c in range(classes.max() + 1):
+        sel = classes == c
+        stream.write(
+            f"  class {c}: n={int(sel.sum())}, pct10 in "
+            f"[{times[sel].min():.3e}, {times[sel].max():.3e}]\n"
+        )
+    rules = ""
+    if classes.max() > 0:
+        X, feats = schedule_features(rows)
+        rules = fit_rules(X, classes, feats, max_depth)
+        stream.write("design rules (decision tree over schedule features):\n")
+        stream.write(rules)
+    return {"n": len(rows), "boundaries": bounds, "classes": classes.tolist(), "rules": rules}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("csv", help="solver result database (pipe-delimited)")
+    ap.add_argument("--max-depth", type=int, default=3)
+    args = ap.parse_args()
+    with open(args.csv) as f:
+        analyze(f.read(), args.max_depth)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

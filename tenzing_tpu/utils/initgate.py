@@ -1,0 +1,55 @@
+"""One-shot library initialization and research-notice gate.
+
+Parity target: reference ``src/init.cpp:24-67`` — ``tenzing::init()`` is
+idempotent, prints a research-software notice once, and requires acknowledgment
+via an environment variable before long runs proceed silently.
+
+TPU-native differences: no MPI_Init to wrap (process bring-up is
+``jax.distributed.initialize``, owned by parallel/control_plane.py), so init()
+is pure host-side bookkeeping: the notice gate plus recording argv/start time
+for the reproduce stamp (utils/reproduce.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Optional
+
+ACK_ENV = "TENZING_TPU_ACK_NOTICE"
+
+NOTICE = """\
+tenzing_tpu is research software: schedules it explores are executed and timed
+on the attached devices.  Set {env}=1 to acknowledge and silence this notice.
+""".format(env=ACK_ENV)
+
+_initialized = False
+_init_time: Optional[float] = None
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_time() -> Optional[float]:
+    """Wall-clock time of the first init() call (for reproduce stamps)."""
+    return _init_time
+
+
+def init(stream=None) -> None:
+    """Idempotent library init (reference init.cpp:24-41): print the research
+    notice unless acknowledged via the environment."""
+    global _initialized, _init_time
+    if _initialized:
+        return
+    _initialized = True
+    _init_time = time.time()
+    if os.environ.get(ACK_ENV, "") not in ("1", "true", "yes"):
+        (stream or sys.stderr).write(NOTICE)
+
+
+def _reset_for_tests() -> None:
+    global _initialized, _init_time
+    _initialized = False
+    _init_time = None

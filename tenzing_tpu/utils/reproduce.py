@@ -1,0 +1,60 @@
+"""Reproducibility stamp: one JSON line with version, VCS state, and argv.
+
+Parity target: reference ``src/reproduce.cpp:22-46`` (``reproduce::dump_with_cli``
+prints ``{"version": ..., "git": ..., "argv": [...]}``; version/git-hash are baked
+in by CMake from ``git describe``, CMakeLists.txt:21-44).  Here the stamp is
+computed at call time: package version from ``tenzing_tpu.__version__``, git
+hash/dirty state read from the working tree when available.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def git_info(cwd: Optional[str] = None) -> dict:
+    """{"hash": ..., "dirty": bool} of the checkout enclosing this package (not
+    the caller's cwd), or {} when not in one (reference bakes this in at
+    configure time; we read it live)."""
+    import os
+
+    if cwd is None:
+        cwd = os.path.dirname(os.path.abspath(__file__))
+    try:
+        h = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd, capture_output=True, text=True, timeout=5,
+        )
+        if h.returncode != 0:
+            return {}
+        s = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=cwd, capture_output=True, text=True, timeout=5,
+        )
+        return {"hash": h.stdout.strip(), "dirty": bool(s.stdout.strip())}
+    except Exception:
+        return {}
+
+
+def stamp(argv: Optional[List[str]] = None) -> dict:
+    import jax
+
+    from tenzing_tpu import __version__
+
+    return {
+        "tenzing_tpu": __version__,
+        "jax": jax.__version__,
+        "git": git_info(),
+        "argv": list(sys.argv if argv is None else argv),
+    }
+
+
+def dump_with_cli(argv: Optional[List[str]] = None, stream=None) -> str:
+    """Print the stamp as one JSON line (reference reproduce.cpp:22-37) and
+    return it."""
+    line = json.dumps(stamp(argv))
+    (stream or sys.stderr).write(line + "\n")
+    return line

@@ -1,0 +1,112 @@
+"""Aux subsystems: init gate, reproduce stamp, postprocess analysis, example CLIs.
+
+Reference analogs: init.cpp (notice gate), reproduce.cpp (stamp),
+postprocess/postprocess.py (class boundaries + decision-tree rules), examples/
+drivers (SURVEY.md §2.3, §5).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from tenzing_tpu.utils import initgate, reproduce
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_init_notice_once(monkeypatch):
+    initgate._reset_for_tests()
+    monkeypatch.delenv(initgate.ACK_ENV, raising=False)
+    buf = io.StringIO()
+    initgate.init(stream=buf)
+    assert initgate.ACK_ENV in buf.getvalue()
+    buf2 = io.StringIO()
+    initgate.init(stream=buf2)  # one-shot (reference init.cpp:24-41)
+    assert buf2.getvalue() == ""
+    assert initgate.is_initialized()
+
+
+def test_init_ack_silences(monkeypatch):
+    initgate._reset_for_tests()
+    monkeypatch.setenv(initgate.ACK_ENV, "1")
+    buf = io.StringIO()
+    initgate.init(stream=buf)
+    assert buf.getvalue() == ""
+
+
+def test_reproduce_stamp():
+    buf = io.StringIO()
+    line = reproduce.dump_with_cli(["prog", "--flag"], stream=buf)
+    d = json.loads(line)
+    assert d["argv"] == ["prog", "--flag"]
+    assert d["tenzing_tpu"]
+    assert "hash" in d["git"]  # tests run inside the repo checkout
+    assert buf.getvalue().strip() == line
+
+
+def _fake_rows(n_fast=20, n_slow=20):
+    """Two clear performance classes separated by lane:spmv assignment."""
+    rows = []
+    idx = 0
+    for lane, base in ((0, 1e-4), (1, 5e-4)):
+        for i in range(n_fast if lane == 0 else n_slow):
+            t = base * (1 + 0.01 * i)
+            ops = [
+                {"kind": "start", "name": "start"},
+                {"kind": "device", "name": "spmv", "lane": lane},
+                {"kind": "device", "name": "scatter", "lane": 1 - lane},
+                {"kind": "finish", "name": "finish"},
+            ]
+            cells = [str(idx)] + [repr(t)] * 5 + [repr(0.0)] + [json.dumps(o) for o in ops]
+            rows.append("|".join(cells))
+            idx += 1
+    return "\n".join(rows)
+
+
+def test_postprocess_classes_and_rules():
+    sys.path.insert(0, REPO)
+    from postprocess.postprocess import analyze, class_boundaries, load_rows
+
+    text = _fake_rows()
+    rows = load_rows(text)
+    assert len(rows) == 40 and rows[0]["ops"][1]["name"] == "spmv"
+    buf = io.StringIO()
+    out = analyze(text, stream=buf)
+    assert out["n"] == 40
+    assert len(out["boundaries"]) == 1  # the 5x gap, and only it
+    assert "lane:" in out["rules"]  # the tree explains the split by a lane feature
+    assert "performance classes" in buf.getvalue()
+
+
+def test_class_boundaries_flat_is_one_class():
+    from postprocess.postprocess import class_boundaries
+
+    assert class_boundaries(np.full(100, 3.0)) == []
+
+
+def test_example_spmv_dfs_smoke():
+    """Tiny end-to-end run of the DFS example CLI on CPU (reference CI runs
+    build + CPU subset only, SURVEY.md §4)."""
+    p = subprocess.run(
+        [sys.executable, "examples/spmv_dfs.py", "--cpu", "--matrix-m", "64",
+         "--max-seqs", "4", "--benchmark-iters", "3"],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+    )
+    assert p.returncode == 0, p.stderr
+    lines = [l for l in p.stdout.splitlines() if l.strip()]
+    assert lines and all("|" in l for l in lines)
+    assert "best:" in p.stderr
+
+
+def test_example_spmv_mcts_smoke():
+    p = subprocess.run(
+        [sys.executable, "examples/spmv_mcts.py", "--cpu", "--matrix-m", "64",
+         "--mcts-iters", "3", "--benchmark-iters", "3", "--strategy", "Coverage"],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+    )
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.strip()
