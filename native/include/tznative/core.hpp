@@ -1,0 +1,123 @@
+// Native search core: the host-side hot path of the schedule search.
+//
+// The reference implements its whole scheduler in C++ (graph.hpp/state.cpp/
+// event_synchronizer.hpp, see SURVEY.md C2/C7/C8); here the same role is played
+// by this library: the Python layer lowers an op DAG to a compact numeric
+// description (ops = integer ids, kinds, edge list) and delegates the
+// combinatorial work — frontier computation, sync-op inference, decision
+// enumeration, equivalence-dedup'd DFS, random rollouts — to native code.
+// Device execution stays in XLA; this layer never touches a device.
+//
+// Semantics mirror tenzing_tpu/core/{graph,event_synchronizer,state,sequence}.py
+// item for item (each mirrors the reference file cited in its docstring); the
+// Python test suite cross-checks the two implementations on the same graphs.
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+namespace tznative {
+
+// Op kinds (lowered from the Python class hierarchy).
+enum Kind : int32_t {
+  KIND_HOST = 0,    // CpuOp/NoOp: occupies the implicit host chain
+  KIND_DEVICE = 1,  // DeviceOp: must be bound to a lane before execution
+  KIND_START = 2,   // Start sentinel (host semantics)
+  KIND_FINISH = 3,  // Finish sentinel (host semantics)
+};
+
+// Schedule items and decisions as (tag, a, b) triples.
+enum Tag : int32_t {
+  TAG_EXEC = 0,        // execute op a (b = lane, -1 for host ops)
+  TAG_RECORD = 1,      // EventRecord(lane=a, event=b)
+  TAG_WAIT = 2,        // WaitEvent(lane=a, event=b)
+  TAG_SYNC_EVENT = 3,  // EventSync(event=a)
+  TAG_SYNC_LANE = 4,   // LaneSync(lane=a)
+  TAG_ASSIGN = 5,      // decision only: bind op a to lane b
+};
+
+struct Item {
+  int32_t tag;
+  int32_t a;
+  int32_t b;
+  bool operator==(const Item& o) const {
+    return tag == o.tag && a == o.a && b == o.b;
+  }
+};
+
+// The structural DAG: ops 0..n-1 with preds/succs in edge-insertion order
+// (must match the Python Graph's insertion-ordered adjacency so decision
+// order is identical across implementations).
+struct Graph {
+  int32_t n = 0;
+  std::vector<int32_t> kinds;
+  std::vector<std::vector<int32_t>> preds;
+  std::vector<std::vector<int32_t>> succs;
+  int32_t start = -1;
+  int32_t finish = -1;
+
+  static Graph build(int32_t n_ops, const int32_t* kinds, int32_t n_edges,
+                     const int32_t* edges);
+};
+
+// A partial schedule: per-op lane bindings (-1 = unbound) + the item sequence.
+// The Python State carries (graph-with-bindings, sequence); bindings here are
+// the graph side of that pair (graph structure itself never changes during the
+// order/lane search — compound expansion happens before lowering).
+struct State {
+  std::vector<int32_t> bindings;
+  std::vector<Item> seq;
+
+  bool executed(int32_t op) const;
+  bool is_terminal(const Graph& g) const { return executed(g.finish); }
+};
+
+// -- event synchronizer (mirrors core/event_synchronizer.py, itself the
+//    reference event_synchronizer.hpp:29-242 truth table) ---------------------
+
+// True iff every device predecessor of `op` is provably ordered before it in
+// `st.seq` via record/wait (device target) or record/sync (host target) pairs.
+bool is_synced(const Graph& g, const State& st, int32_t op);
+
+// The next missing sync item(s) before `op` is executable; empty iff synced.
+std::vector<Item> make_syncs(const Graph& g, const State& st, int32_t op);
+
+// -- SDP stepping (mirrors core/state.py get_decisions/apply) -----------------
+
+// Decisions from the frontier, in the Python layer's exact order:
+// per frontier op (op-id order): Execute / Execute-sync / AssignLane-per-lane;
+// deduplicated by triple equality.
+std::vector<Item> get_decisions(const Graph& g, const State& st, int32_t n_lanes);
+
+// Successor state.
+State apply(const Graph& g, const State& st, const Item& decision);
+
+// -- equivalence (mirrors core/sequence.py + core/state.py get_equivalence) ---
+
+// Canonical form of a state under consistent lane/event renaming: the item
+// sequence with lanes/events relabeled in first-use order, then (for state
+// equivalence, `with_bindings`) every op's bound-ness/lane through the same
+// relabeling.  Two states are bijection-equivalent iff their canonical keys
+// are equal — the hashable replacement for the reference's pairwise
+// Bijection checks (platform.hpp:248-270, state.cpp:126-143).
+std::string canonical_key(const State& st, bool with_bindings);
+
+// -- enumeration / rollout ----------------------------------------------------
+
+// Worklist DFS over State::frontier with per-expansion equivalence dedup
+// (mirrors solve/dfs.py get_all_sequences / reference dfs.cpp:16-82), plus
+// optional terminal-sequence dedup (reference dfs.hpp:88-113).  `init_bindings`
+// carries lane assignments the caller pinned in the graph (empty = all
+// unbound); pinned ops are executed on their fixed lane, never re-assigned.
+std::vector<State> enumerate_sequences(const Graph& g, int32_t n_lanes,
+                                       int32_t max_seqs, bool dedup_terminals,
+                                       const std::vector<int32_t>& init_bindings);
+
+// Uniform-random playout to a terminal state (mirrors solve/mcts/node.py
+// get_rollout's random descent).
+State rollout(const Graph& g, State st, int32_t n_lanes, uint64_t seed);
+
+}  // namespace tznative

@@ -1,0 +1,161 @@
+// C ABI over the native search core, consumed by tenzing_tpu/native/bridge.py
+// via ctypes (the image has no pybind11; a plain C ABI also keeps the library
+// usable from any host language, as the reference's C++ API is).
+//
+// Conventions:
+//   * schedules/decisions cross the boundary as flat int32 (tag, a, b) triples
+//     (see tznative::Tag);
+//   * functions writing variable-length output take (out, cap) and return the
+//     number of int32s written, or -needed when cap is too small (caller
+//     retries), or TZ_ERROR after an exception (message via tz_last_error).
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tznative/core.hpp"
+
+using namespace tznative;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+constexpr int64_t TZ_ERROR = -1000000000;
+
+State make_state(const Graph& g, const int32_t* bindings, int32_t seq_len,
+                 const int32_t* seq) {
+  State st;
+  st.bindings.assign(bindings, bindings + g.n);
+  st.seq.reserve(seq_len);
+  for (int32_t i = 0; i < seq_len; ++i)
+    st.seq.push_back({seq[3 * i], seq[3 * i + 1], seq[3 * i + 2]});
+  return st;
+}
+
+int64_t write_items(const std::vector<Item>& items, int32_t* out, int64_t cap) {
+  int64_t need = (int64_t)items.size() * 3;
+  if (need > cap) return -need;
+  for (size_t i = 0; i < items.size(); ++i) {
+    out[3 * i] = items[i].tag;
+    out[3 * i + 1] = items[i].a;
+    out[3 * i + 2] = items[i].b;
+  }
+  return need;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t tz_abi_version() { return 2; }
+
+const char* tz_last_error() { return g_last_error.c_str(); }
+
+void* tz_graph_create(int32_t n_ops, const int32_t* kinds, int32_t n_edges,
+                      const int32_t* edges) {
+  try {
+    return new Graph(Graph::build(n_ops, kinds, n_edges, edges));
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+void tz_graph_destroy(void* g) { delete static_cast<Graph*>(g); }
+
+// Decisions of a state, as triples.  Returns #int32s written / -needed / TZ_ERROR.
+int64_t tz_decisions(void* gp, int32_t n_lanes, const int32_t* bindings,
+                     int32_t seq_len, const int32_t* seq, int32_t* out,
+                     int64_t cap) {
+  try {
+    const Graph& g = *static_cast<Graph*>(gp);
+    State st = make_state(g, bindings, seq_len, seq);
+    return write_items(get_decisions(g, st, n_lanes), out, cap);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return TZ_ERROR;
+  }
+}
+
+// Random playout to terminal.  Writes the FULL final sequence (prefix
+// included) to out_seq; lane assignments ride in the TAG_EXEC items.
+int64_t tz_rollout(void* gp, int32_t n_lanes, const int32_t* bindings,
+                   int32_t seq_len, const int32_t* seq, uint64_t seed,
+                   int32_t* out_seq, int64_t cap) {
+  try {
+    const Graph& g = *static_cast<Graph*>(gp);
+    State st = rollout(g, make_state(g, bindings, seq_len, seq), n_lanes, seed);
+    return write_items(st.seq, out_seq, cap);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return TZ_ERROR;
+  }
+}
+
+namespace {
+// Result of the last tz_enum_run on this thread, fetched by tz_enum_fetch —
+// a two-phase protocol so an undersized fetch buffer never re-runs the
+// (potentially exponential) enumeration.
+thread_local std::vector<int32_t> g_enum_result;
+}  // namespace
+
+// Exhaustive dedup'd enumeration (phase 1: compute).  `bindings` carries
+// caller-pinned lane assignments (or all -1).  Stores the result thread-local;
+// returns total int32s to fetch / TZ_ERROR; *n_seqs_out = #sequences.
+// Layout per sequence: [n_items, tag,a,b, tag,a,b, ...].
+int64_t tz_enum_run(void* gp, int32_t n_lanes, const int32_t* bindings,
+                    int32_t max_seqs, int32_t dedup_terminals,
+                    int32_t* n_seqs_out) {
+  try {
+    const Graph& g = *static_cast<Graph*>(gp);
+    std::vector<int32_t> init(bindings, bindings + g.n);
+    std::vector<State> terminals =
+        enumerate_sequences(g, n_lanes, max_seqs, dedup_terminals != 0, init);
+    *n_seqs_out = (int32_t)terminals.size();
+    g_enum_result.clear();
+    for (const State& st : terminals) {
+      g_enum_result.push_back((int32_t)st.seq.size());
+      for (const Item& it : st.seq) {
+        g_enum_result.push_back(it.tag);
+        g_enum_result.push_back(it.a);
+        g_enum_result.push_back(it.b);
+      }
+    }
+    return (int64_t)g_enum_result.size();
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return TZ_ERROR;
+  }
+}
+
+// Phase 2: copy the stored result out and release it.  Returns int32s written
+// or -needed (result retained so the caller can retry with a bigger buffer).
+int64_t tz_enum_fetch(int32_t* out, int64_t cap) {
+  int64_t need = (int64_t)g_enum_result.size();
+  if (need > cap) return -need;
+  std::memcpy(out, g_enum_result.data(), need * sizeof(int32_t));
+  g_enum_result.clear();
+  g_enum_result.shrink_to_fit();
+  return need;
+}
+
+// Canonical equivalence key of a sequence (with_bindings=0) or full state
+// (with_bindings=1), as raw bytes.  Returns byte length / -needed / TZ_ERROR.
+int64_t tz_canonical_key(void* gp, const int32_t* bindings, int32_t seq_len,
+                         const int32_t* seq, int32_t with_bindings, char* out,
+                         int64_t cap) {
+  try {
+    const Graph& g = *static_cast<Graph*>(gp);
+    State st = make_state(g, bindings, seq_len, seq);
+    std::string k = canonical_key(st, with_bindings != 0);
+    if ((int64_t)k.size() > cap) return -(int64_t)k.size();
+    std::memcpy(out, k.data(), k.size());
+    return (int64_t)k.size();
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return TZ_ERROR;
+  }
+}
+
+}  // extern "C"

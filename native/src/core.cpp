@@ -1,0 +1,316 @@
+// Implementation of the native search core.  Every function cites the Python
+// module it mirrors; the Python docstrings carry the reference (CUDA/C++)
+// file:line provenance.
+
+#include "tznative/core.hpp"
+
+#include <algorithm>
+#include <random>
+#include <stdexcept>
+
+namespace tznative {
+
+Graph Graph::build(int32_t n_ops, const int32_t* kinds_in, int32_t n_edges,
+                   const int32_t* edges) {
+  Graph g;
+  g.n = n_ops;
+  g.kinds.assign(kinds_in, kinds_in + n_ops);
+  g.preds.resize(n_ops);
+  g.succs.resize(n_ops);
+  for (int32_t i = 0; i < n_ops; ++i) {
+    if (g.kinds[i] == KIND_START) g.start = i;
+    if (g.kinds[i] == KIND_FINISH) g.finish = i;
+  }
+  for (int32_t e = 0; e < n_edges; ++e) {
+    int32_t a = edges[2 * e], b = edges[2 * e + 1];
+    if (a < 0 || a >= n_ops || b < 0 || b >= n_ops)
+      throw std::invalid_argument("edge endpoint out of range");
+    // duplicate-edge tolerance matches Python Graph.then (graph.py:63-72)
+    if (std::find(g.succs[a].begin(), g.succs[a].end(), b) == g.succs[a].end())
+      g.succs[a].push_back(b);
+    if (std::find(g.preds[b].begin(), g.preds[b].end(), a) == g.preds[b].end())
+      g.preds[b].push_back(a);
+  }
+  if (g.start < 0 || g.finish < 0)
+    throw std::invalid_argument("graph must contain start and finish sentinels");
+  return g;
+}
+
+bool State::executed(int32_t op) const {
+  for (const Item& it : seq)
+    if (it.tag == TAG_EXEC && it.a == op) return true;
+  return false;
+}
+
+// -- synchronizer -------------------------------------------------------------
+
+namespace {
+
+int seq_index_of_exec(const State& st, int32_t op) {
+  for (size_t i = 0; i < st.seq.size(); ++i)
+    if (st.seq[i].tag == TAG_EXEC && st.seq[i].a == op) return (int)i;
+  return -1;
+}
+
+// mirrors event_synchronizer.py _device_then_device_synced
+bool device_then_device_synced(const State& st, int32_t pred_lane, int pred_idx,
+                               int32_t op_lane) {
+  if (pred_lane == op_lane) return true;
+  const auto& s = st.seq;
+  for (size_t i = pred_idx + 1; i < s.size(); ++i) {
+    if (s[i].tag == TAG_RECORD && s[i].a == pred_lane) {
+      for (size_t j = i + 1; j < s.size(); ++j)
+        if (s[j].tag == TAG_WAIT && s[j].a == op_lane && s[j].b == s[i].b)
+          return true;
+    }
+  }
+  return false;
+}
+
+// mirrors event_synchronizer.py _device_then_host_synced
+bool device_then_host_synced(const State& st, int32_t pred_lane, int pred_idx) {
+  const auto& s = st.seq;
+  for (size_t i = pred_idx + 1; i < s.size(); ++i) {
+    if (s[i].tag == TAG_SYNC_LANE && s[i].a == pred_lane) return true;
+    if (s[i].tag == TAG_RECORD && s[i].a == pred_lane) {
+      for (size_t j = i + 1; j < s.size(); ++j)
+        if (s[j].tag == TAG_SYNC_EVENT && s[j].a == s[i].b) return true;
+    }
+  }
+  return false;
+}
+
+// first EventRecord on `lane` after `pos` (event_synchronizer.py _find_record_after)
+int find_record_after(const State& st, int pos, int32_t lane) {
+  for (size_t i = pos + 1; i < st.seq.size(); ++i)
+    if (st.seq[i].tag == TAG_RECORD && st.seq[i].a == lane) return (int)i;
+  return -1;
+}
+
+// smallest event id unused in seq and pending syncs (sequence.py new_unique_event)
+int32_t fresh_event(const State& st, const std::vector<Item>& pending) {
+  std::unordered_set<int32_t> used;
+  auto note = [&used](const Item& it) {
+    if (it.tag == TAG_RECORD || it.tag == TAG_WAIT) used.insert(it.b);
+    if (it.tag == TAG_SYNC_EVENT) used.insert(it.a);
+  };
+  for (const Item& it : st.seq) note(it);
+  for (const Item& it : pending) note(it);
+  int32_t e = 0;
+  while (used.count(e)) ++e;
+  return e;
+}
+
+bool is_bound_device(const Graph& g, const State& st, int32_t op) {
+  return g.kinds[op] == KIND_DEVICE && st.bindings[op] >= 0;
+}
+
+}  // namespace
+
+bool is_synced(const Graph& g, const State& st, int32_t op) {
+  bool op_device = is_bound_device(g, st, op);
+  int32_t op_lane = op_device ? st.bindings[op] : -1;
+  for (int32_t pred : g.preds[op]) {
+    if (!is_bound_device(g, st, pred)) continue;  // host -> anything is free
+    int pi = seq_index_of_exec(st, pred);
+    if (pi < 0) throw std::logic_error("is_synced: predecessor not executed");
+    if (op_device) {
+      if (!device_then_device_synced(st, st.bindings[pred], pi, op_lane))
+        return false;
+    } else {
+      if (!device_then_host_synced(st, st.bindings[pred], pi)) return false;
+    }
+  }
+  return true;
+}
+
+std::vector<Item> make_syncs(const Graph& g, const State& st, int32_t op) {
+  std::vector<Item> syncs;
+  auto emit = [&syncs](const Item& s) {
+    if (std::find(syncs.begin(), syncs.end(), s) == syncs.end())
+      syncs.push_back(s);
+  };
+  bool op_device = is_bound_device(g, st, op);
+  int32_t op_lane = op_device ? st.bindings[op] : -1;
+  for (int32_t pred : g.preds[op]) {
+    if (!is_bound_device(g, st, pred)) continue;
+    int32_t pred_lane = st.bindings[pred];
+    int pi = seq_index_of_exec(st, pred);
+    if (pi < 0) throw std::logic_error("make_syncs: predecessor not executed");
+    if (op_device) {
+      if (device_then_device_synced(st, pred_lane, pi, op_lane)) continue;
+    } else {
+      if (device_then_host_synced(st, pred_lane, pi)) continue;
+    }
+    int ri = find_record_after(st, pi, pred_lane);
+    if (ri < 0) {
+      // covered if an identical-lane record is already pending this call
+      bool pending = false;
+      for (const Item& s : syncs)
+        if (s.tag == TAG_RECORD && s.a == pred_lane) pending = true;
+      if (!pending)
+        emit({TAG_RECORD, pred_lane, fresh_event(st, syncs)});
+    } else if (op_device) {
+      emit({TAG_WAIT, op_lane, st.seq[ri].b});
+    } else {
+      emit({TAG_SYNC_EVENT, st.seq[ri].b, -1});
+    }
+  }
+  return syncs;
+}
+
+// -- SDP stepping -------------------------------------------------------------
+
+std::vector<Item> get_decisions(const Graph& g, const State& st, int32_t n_lanes) {
+  // frontier: ops not executed whose preds are all executed, in op-id order
+  // (mirrors graph.py frontier over insertion-ordered vertices)
+  std::vector<bool> done(g.n, false);
+  for (const Item& it : st.seq)
+    if (it.tag == TAG_EXEC) done[it.a] = true;
+  std::vector<Item> decisions;
+  auto emit = [&decisions](const Item& d) {
+    if (std::find(decisions.begin(), decisions.end(), d) == decisions.end())
+      decisions.push_back(d);
+  };
+  for (int32_t v = 0; v < g.n; ++v) {
+    if (done[v]) continue;
+    bool ready = true;
+    for (int32_t p : g.preds[v])
+      if (!done[p]) { ready = false; break; }
+    if (!ready) continue;
+    if (g.kinds[v] == KIND_DEVICE && st.bindings[v] < 0) {
+      for (int32_t l = 0; l < n_lanes; ++l) emit({TAG_ASSIGN, v, l});
+      continue;
+    }
+    std::vector<Item> syncs = make_syncs(g, st, v);
+    if (syncs.empty()) {
+      emit({TAG_EXEC, v, g.kinds[v] == KIND_DEVICE ? st.bindings[v] : -1});
+    } else {
+      for (const Item& s : syncs) emit(s);
+    }
+  }
+  return decisions;
+}
+
+State apply(const Graph& g, const State& st, const Item& d) {
+  State nx = st;
+  if (d.tag == TAG_ASSIGN) {
+    nx.bindings[d.a] = d.b;
+  } else if (d.tag == TAG_EXEC) {
+    nx.seq.push_back({TAG_EXEC, d.a, g.kinds[d.a] == KIND_DEVICE ? st.bindings[d.a] : -1});
+  } else {
+    nx.seq.push_back(d);  // a sync item is executed by appending it
+  }
+  return nx;
+}
+
+// -- equivalence --------------------------------------------------------------
+
+namespace {
+
+struct Relabel {
+  std::vector<int32_t> map;  // id -> label, -1 = unseen
+  int32_t next = 0;
+  int32_t operator()(int32_t id) {
+    if (id < 0) return id;
+    if ((size_t)id >= map.size()) map.resize(id + 1, -1);
+    if (map[id] < 0) map[id] = next++;
+    return map[id];
+  }
+};
+
+}  // namespace
+
+std::string canonical_key(const State& st, bool with_bindings) {
+  Relabel lane, event;
+  std::vector<int32_t> key;
+  key.reserve(st.seq.size() * 3 + (with_bindings ? st.bindings.size() : 0));
+  for (const Item& it : st.seq) {
+    key.push_back(it.tag);
+    switch (it.tag) {
+      case TAG_EXEC:
+        key.push_back(it.a);
+        key.push_back(lane(it.b));
+        break;
+      case TAG_RECORD:
+      case TAG_WAIT:
+        key.push_back(lane(it.a));
+        key.push_back(event(it.b));
+        break;
+      case TAG_SYNC_EVENT:
+        key.push_back(event(it.a));
+        key.push_back(-1);
+        break;
+      case TAG_SYNC_LANE:
+        key.push_back(lane(it.a));
+        key.push_back(-1);
+        break;
+      default:
+        throw std::logic_error("canonical_key: unexpected tag");
+    }
+  }
+  if (with_bindings) {
+    // the graph half of state equivalence (state.py get_equivalence): every
+    // vertex's bound-ness and lane through the same renaming
+    key.push_back(-2);  // section separator
+    for (int32_t b : st.bindings) key.push_back(lane(b));
+  }
+  return std::string(reinterpret_cast<const char*>(key.data()),
+                     key.size() * sizeof(int32_t));
+}
+
+// -- enumeration / rollout ----------------------------------------------------
+
+std::vector<State> enumerate_sequences(const Graph& g, int32_t n_lanes,
+                                       int32_t max_seqs, bool dedup_terminals,
+                                       const std::vector<int32_t>& init_bindings) {
+  std::vector<State> terminals;
+  std::vector<State> stack;
+  State init;
+  if (init_bindings.empty()) {
+    init.bindings.assign(g.n, -1);
+  } else {
+    if ((int32_t)init_bindings.size() != g.n)
+      throw std::invalid_argument("init_bindings size mismatch");
+    init.bindings = init_bindings;
+  }
+  init.seq.push_back({TAG_EXEC, g.start, -1});
+  stack.push_back(std::move(init));
+  std::unordered_set<std::string> terminal_keys;
+  while (!stack.empty() && (int32_t)terminals.size() < max_seqs) {
+    State st = std::move(stack.back());
+    stack.pop_back();
+    if (st.is_terminal(g)) {
+      if (dedup_terminals) {
+        // terminal dedup is sequence-only (solve/dfs.py _dedup_terminal_states)
+        std::string k = canonical_key(st, /*with_bindings=*/false);
+        if (!terminal_keys.insert(std::move(k)).second) continue;
+      }
+      terminals.push_back(std::move(st));
+      continue;
+    }
+    // per-expansion successor dedup under full state equivalence
+    // (state.py State.frontier with dedup=True)
+    std::unordered_set<std::string> succ_keys;
+    for (const Item& d : get_decisions(g, st, n_lanes)) {
+      State nx = apply(g, st, d);
+      std::string k = canonical_key(nx, /*with_bindings=*/true);
+      if (succ_keys.insert(std::move(k)).second) stack.push_back(std::move(nx));
+    }
+  }
+  return terminals;
+}
+
+State rollout(const Graph& g, State st, int32_t n_lanes, uint64_t seed) {
+  std::mt19937_64 rng(seed);
+  while (!st.is_terminal(g)) {
+    std::vector<Item> ds = get_decisions(g, st, n_lanes);
+    if (ds.empty())
+      throw std::logic_error("rollout: non-terminal state with no decisions");
+    std::uniform_int_distribution<size_t> pick(0, ds.size() - 1);
+    st = apply(g, st, ds[pick(rng)]);
+  }
+  return st;
+}
+
+}  // namespace tznative

@@ -89,10 +89,16 @@ def schedule_features(rows: List[dict]) -> Tuple[np.ndarray, List[str]]:
                 all_names.append(name)
                 if "lane" in op:
                     lane_ops.append(name)
+    sentinel_names = {
+        op["name"]
+        for r in rows
+        for op in r["ops"]
+        if op.get("kind") in ("start", "finish") and "name" in op
+    }
     feats: List[str] = [f"lane:{n}" for n in lane_ops]
     pairs = [
         (a, b) for i, a in enumerate(all_names) for b in all_names[i + 1 :]
-        if not (a.startswith(("start", "finish")) or b.startswith(("start", "finish")))
+        if a not in sentinel_names and b not in sentinel_names
     ]
     feats += [f"before:{a}<{b}" for a, b in pairs]
     X = np.zeros((len(rows), len(feats)), dtype=np.float32)

@@ -1,0 +1,20 @@
+"""Native (C++) search core bindings.
+
+The reference implements its scheduler entirely in C++ (SURVEY.md §2); here the
+host-side search hot path — frontier/decision enumeration, sync inference,
+equivalence-dedup'd DFS, random rollouts — has a C++17 implementation
+(``native/`` at the repo root) loaded via ctypes.  The Python implementations in
+``tenzing_tpu.core`` remain the reference semantics; solvers call
+``bridge.try_*`` helpers which return ``None`` when the native library is
+unavailable or the graph is not lowerable, falling back to Python.
+
+Set ``TENZING_TPU_NATIVE=0`` to disable, ``=1`` to require (build errors raise).
+"""
+
+from tenzing_tpu.native.bridge import (  # noqa: F401
+    NotLowerable,
+    native_available,
+    try_decisions,
+    try_enumerate,
+    try_rollout,
+)

@@ -1,0 +1,387 @@
+"""ctypes bridge to the native search core (native/ at the repo root).
+
+Lowering: a Python Graph whose vertices are all Start/Finish/CpuOp/DeviceOp/
+BoundDeviceOp (i.e. compound/choice ops already expanded) maps to the native
+description — ops numbered in vertex-insertion order, kinds, the edge list in
+insertion order (order matters: decision enumeration must match the Python
+layer exactly).  Schedules cross the boundary as (tag, a, b) int32 triples
+(native/include/tznative/core.hpp Tag).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import (
+    BoundDeviceOp,
+    BoundOp,
+    CpuOp,
+    DeviceOp,
+    Finish,
+    OpBase,
+    Start,
+)
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.state import AssignLane, Decision, ExecuteOp, State
+from tenzing_tpu.core.sync_ops import EventRecord, EventSync, LaneSync, SyncOp, WaitEvent
+
+# kinds/tags — keep in sync with native/include/tznative/core.hpp
+KIND_HOST, KIND_DEVICE, KIND_START, KIND_FINISH = 0, 1, 2, 3
+TAG_EXEC, TAG_RECORD, TAG_WAIT, TAG_SYNC_EVENT, TAG_SYNC_LANE, TAG_ASSIGN = range(6)
+
+TZ_ERROR = -1000000000
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_SO_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_tznative.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+class NotLowerable(Exception):
+    """The graph/sequence contains ops the native core cannot represent."""
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+def _mode() -> str:
+    return os.environ.get("TENZING_TPU_NATIVE", "auto").lower()
+
+
+def _sources_mtime() -> float:
+    newest = 0.0
+    for root, _dirs, files in os.walk(_NATIVE_DIR):
+        for f in files:
+            if f.endswith((".cpp", ".hpp")):
+                newest = max(newest, os.path.getmtime(os.path.join(root, f)))
+    return newest
+
+
+def _build() -> None:
+    """Run make under an exclusive file lock: concurrent processes (multi-host
+    control plane, parallel pytest) must not race writes to the same .so."""
+    import fcntl
+
+    lock_path = os.path.join(os.path.dirname(_SO_PATH), ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            # a racer may have finished the build while we waited for the lock
+            if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= _sources_mtime():
+                return
+            p = subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+            if p.returncode != 0:
+                raise NativeError(f"native build failed:\n{p.stdout}\n{p.stderr}")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _mode() in ("0", "off", "false"):
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed and _mode() != "1":
+            return None
+        try:
+            if not os.path.exists(_SO_PATH) or os.path.getmtime(_SO_PATH) < _sources_mtime():
+                _build()
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.tz_abi_version.restype = ctypes.c_int32
+            if lib.tz_abi_version() != 2:
+                raise NativeError("native ABI version mismatch; run make -C native clean")
+            lib.tz_last_error.restype = ctypes.c_char_p
+            lib.tz_graph_create.restype = ctypes.c_void_p
+            lib.tz_graph_create.argtypes = [
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.tz_graph_destroy.argtypes = [ctypes.c_void_p]
+            lib.tz_decisions.restype = ctypes.c_int64
+            lib.tz_decisions.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+            ]
+            lib.tz_rollout.restype = ctypes.c_int64
+            lib.tz_rollout.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+            ]
+            lib.tz_enum_run.restype = ctypes.c_int64
+            lib.tz_enum_run.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.tz_enum_fetch.restype = ctypes.c_int64
+            lib.tz_enum_fetch.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64,
+            ]
+            _lib = lib
+            return _lib
+        except Exception:
+            _lib_failed = True
+            if _mode() == "1":
+                raise
+            return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# -- lowering -----------------------------------------------------------------
+
+
+class LoweredGraph:
+    """A Python Graph lowered to a native handle + the vertex table for mapping
+    results back to op objects."""
+
+    def __init__(self, graph: Graph):
+        lib = _load()
+        if lib is None:
+            raise NativeError("native library unavailable")
+        self._lib = lib
+        self.vertices: List[OpBase] = graph.vertices()
+        self.index: Dict[Tuple, int] = {}
+        kinds = []
+        for i, v in enumerate(self.vertices):
+            if isinstance(v, Start):
+                kinds.append(KIND_START)
+            elif isinstance(v, Finish):
+                kinds.append(KIND_FINISH)
+            elif isinstance(v, (DeviceOp, BoundDeviceOp)):
+                kinds.append(KIND_DEVICE)
+            elif isinstance(v, CpuOp):
+                kinds.append(KIND_HOST)
+            else:
+                raise NotLowerable(f"vertex {v!r} (expand compound/choice ops first)")
+            self.index[v.eq_key()] = i
+        edges: List[int] = []
+        n_edges = 0
+        for v in self.vertices:
+            for s in graph.succs(v):
+                edges += [self.index[v.eq_key()], self.index[s.eq_key()]]
+                n_edges += 1
+        kinds_arr = (ctypes.c_int32 * len(kinds))(*kinds)
+        edges_arr = (ctypes.c_int32 * max(1, len(edges)))(*edges)
+        self.n = len(self.vertices)
+        self.handle = lib.tz_graph_create(self.n, kinds_arr, n_edges, edges_arr)
+        if not self.handle:
+            raise NativeError(lib.tz_last_error().decode())
+
+    def __del__(self):
+        h = getattr(self, "handle", None)
+        if h:
+            self._lib.tz_graph_destroy(h)
+            self.handle = None
+
+    # -- python -> native --------------------------------------------------
+    def bindings_of(self, graph: Graph):
+        """Per-op lane bindings from a (possibly re-bound clone of the) graph
+        with the same structure."""
+        b = [-1] * self.n
+        for v in graph.vertices():
+            i = self.index.get(v.eq_key())
+            if i is None:
+                raise NotLowerable(f"graph vertex {v!r} absent from lowered structure")
+            if isinstance(v, BoundDeviceOp):
+                b[i] = v.lane().id
+        return (ctypes.c_int32 * self.n)(*b)
+
+    def lower_sequence(self, seq: Sequence):
+        items: List[int] = []
+        for op in seq:
+            if isinstance(op, EventRecord):
+                items += [TAG_RECORD, op.lane().id, op.event().id]
+            elif isinstance(op, WaitEvent):
+                items += [TAG_WAIT, op.lane().id, op.event().id]
+            elif isinstance(op, EventSync):
+                items += [TAG_SYNC_EVENT, op.event().id, -1]
+            elif isinstance(op, LaneSync):
+                items += [TAG_SYNC_LANE, op.lane().id, -1]
+            elif isinstance(op, SyncOp):
+                raise NotLowerable(f"sync op {op!r} has no native representation")
+            else:
+                i = self.index.get(op.eq_key())
+                if i is None:
+                    raise NotLowerable(f"sequence op {op!r} not a graph vertex")
+                lane = op.lane().id if isinstance(op, BoundDeviceOp) else -1
+                items += [TAG_EXEC, i, lane]
+        n = len(items) // 3
+        return n, (ctypes.c_int32 * max(1, len(items)))(*items)
+
+    # -- native -> python --------------------------------------------------
+    def item_to_op(self, tag: int, a: int, b: int) -> OpBase:
+        if tag == TAG_EXEC:
+            v = self.vertices[a]
+            if b >= 0:
+                if isinstance(v, BoundDeviceOp):
+                    return v if v.lane().id == b else v.with_lane(Lane(b))
+                assert isinstance(v, DeviceOp), v
+                return v.bind(Lane(b))
+            return v
+        if tag == TAG_RECORD:
+            return EventRecord(Lane(a), Event(b))
+        if tag == TAG_WAIT:
+            return WaitEvent(Lane(a), Event(b))
+        if tag == TAG_SYNC_EVENT:
+            return EventSync(Event(a))
+        if tag == TAG_SYNC_LANE:
+            return LaneSync(Lane(a))
+        raise NativeError(f"unexpected item tag {tag}")
+
+    def items_to_sequence(self, flat, n_items: int) -> Sequence:
+        return Sequence(
+            self.item_to_op(flat[3 * i], flat[3 * i + 1], flat[3 * i + 2])
+            for i in range(n_items)
+        )
+
+    def decision_of(self, tag: int, a: int, b: int, graph: Graph) -> Decision:
+        if tag == TAG_ASSIGN:
+            v = graph._vertex(self.vertices[a])
+            assert isinstance(v, DeviceOp) and not isinstance(v, BoundDeviceOp), v
+            return AssignLane(v, Lane(b))
+        if tag == TAG_EXEC:
+            # the graph's stored vertex carries the current binding
+            v = graph._vertex(self.vertices[a])
+            assert isinstance(v, BoundOp), v
+            return ExecuteOp(v)
+        return ExecuteOp(self.item_to_op(tag, a, b))
+
+
+def _lower_state(state: State):
+    lg = LoweredGraph(state.graph)
+    bindings = lg.bindings_of(state.graph)
+    seq_len, seq_arr = lg.lower_sequence(state.sequence)
+    return lg, bindings, seq_len, seq_arr
+
+
+# -- solver entry points ------------------------------------------------------
+
+
+def try_decisions(state: State, platform) -> Optional[List[Decision]]:
+    """Native get_decisions, or None when native is unavailable/not applicable."""
+    if _load() is None:
+        return None
+    try:
+        lg, bindings, seq_len, seq_arr = _lower_state(state)
+    except NotLowerable:
+        return None
+    cap = (lg.n * max(1, len(platform.lanes)) + 16) * 3
+    out = (ctypes.c_int32 * cap)()
+    n = lg._lib.tz_decisions(
+        lg.handle, len(platform.lanes), bindings, seq_len, seq_arr, out, cap
+    )
+    if n == TZ_ERROR:
+        raise NativeError(lg._lib.tz_last_error().decode())
+    if n < 0:  # pragma: no cover - cap is sized generously
+        out = (ctypes.c_int32 * (-n))()
+        n = lg._lib.tz_decisions(
+            lg.handle, len(platform.lanes), bindings, seq_len, seq_arr, out, -n
+        )
+    return [
+        lg.decision_of(out[3 * i], out[3 * i + 1], out[3 * i + 2], state.graph)
+        for i in range(n // 3)
+    ]
+
+
+def try_rollout(state: State, platform, seed: int) -> Optional[Sequence]:
+    """Native random playout to a terminal sequence, or None."""
+    if _load() is None:
+        return None
+    try:
+        lg, bindings, seq_len, seq_arr = _lower_state(state)
+    except NotLowerable:
+        return None
+    cap = (lg.n * 8 + 64) * 3
+    out = (ctypes.c_int32 * cap)()
+    n = lg._lib.tz_rollout(
+        lg.handle, len(platform.lanes), bindings, seq_len, seq_arr,
+        seed & 0xFFFFFFFFFFFFFFFF, out, cap,
+    )
+    if n == TZ_ERROR:
+        raise NativeError(lg._lib.tz_last_error().decode())
+    if n < 0:
+        out = (ctypes.c_int32 * (-n))()
+        n = lg._lib.tz_rollout(
+            lg.handle, len(platform.lanes), bindings, seq_len, seq_arr,
+            seed & 0xFFFFFFFFFFFFFFFF, out, -n,
+        )
+    return lg.items_to_sequence(out, n // 3)
+
+
+def try_enumerate(
+    graph: Graph, platform, max_seqs: int, dedup_terminals: bool = True
+) -> Optional[List[State]]:
+    """Native exhaustive enumeration -> States with lane-bound graphs, or None."""
+    if _load() is None:
+        return None
+    try:
+        lg = LoweredGraph(graph)
+    except NotLowerable:
+        return None
+    n_lanes = len(platform.lanes)
+    n_seqs = ctypes.c_int32(0)
+    # two-phase: run once (honoring caller-pinned lane bindings), then fetch
+    # into an exactly-sized buffer
+    total = lg._lib.tz_enum_run(
+        lg.handle, n_lanes, lg.bindings_of(graph), max_seqs,
+        1 if dedup_terminals else 0, ctypes.byref(n_seqs),
+    )
+    if total == TZ_ERROR:
+        raise NativeError(lg._lib.tz_last_error().decode())
+    out = (ctypes.c_int32 * max(1, total))()
+    n = lg._lib.tz_enum_fetch(out, total)
+    assert n == total, (n, total)
+    states: List[State] = []
+    w = 0
+    for _ in range(n_seqs.value):
+        n_items = out[w]
+        w += 1
+        ops = [
+            lg.item_to_op(out[w + 3 * i], out[w + 3 * i + 1], out[w + 3 * i + 2])
+            for i in range(n_items)
+        ]
+        w += 3 * n_items
+        seq = Sequence(ops)
+        assignment = {
+            op.unbound(): op.lane() for op in ops if isinstance(op, BoundDeviceOp)
+        }
+        bound_graph = graph.apply_lane_assignment(assignment) if assignment else graph
+        states.append(State(bound_graph, seq))
+    return states

@@ -16,6 +16,7 @@ from typing import List, Optional, Tuple
 from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, result_row
 from tenzing_tpu.core import sequence as sequence_mod
 from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import ChoiceOp, CompoundOp
 from tenzing_tpu.core.sequence import Sequence
 from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
 from tenzing_tpu.core.state import State
@@ -80,6 +81,55 @@ def get_all_sequences(
     return terminals
 
 
+def expand_all(graph: Graph) -> Graph:
+    """Inline every CompoundOp.  An ExpandOp is the only decision available for
+    a frontier compound and commutes with execution order, so eager expansion
+    preserves the terminal-schedule space (reference state.cpp:82-87)."""
+    while True:
+        comps = [v for v in graph.vertices() if isinstance(v, CompoundOp)]
+        if not comps:
+            return graph
+        graph = graph.clone_but_expand(comps[0])
+
+
+def structural_variants(graph: Graph) -> List[Graph]:
+    """All graphs reachable by compound expansion and choice substitution —
+    the structural (graph-surgery) half of the decision space, taken eagerly so
+    the order x lane half can run in the native core."""
+    graph = expand_all(graph)
+    choices = [v for v in graph.vertices() if isinstance(v, ChoiceOp)]
+    if not choices:
+        return [graph]
+    out: List[Graph] = []
+    for c in choices[0].choices():
+        out.extend(structural_variants(graph.clone_but_replace(c, choices[0])))
+    return out
+
+
+def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000) -> List[State]:
+    """Terminal states with both per-expansion and terminal dedup applied.
+
+    Structural decisions (compound expansion, implementation choices) are
+    resolved eagerly into graph variants; each variant's order x lane space is
+    enumerated by the native (C++) core when available, else by the Python
+    path.  Note the cap counts *deduplicated* terminals on the native path and
+    raw terminals on the Python path (the native behaviour is strictly more
+    productive)."""
+    from tenzing_tpu.native import bridge
+
+    out: List[State] = []
+    for g in structural_variants(graph):
+        budget = max_seqs - len(out)
+        if budget <= 0:
+            break
+        nat = bridge.try_enumerate(g, platform, budget, dedup_terminals=True)
+        if nat is not None:
+            out.extend(nat)
+        else:
+            out.extend(_dedup_terminal_states(get_all_sequences(g, platform, budget)))
+    return out
+
+
 def _dedup_terminal_states(states: List[State]) -> List[State]:
     """Pairwise dedup of completed schedules under resource bijection
     (reference dfs.hpp:88-113)."""
@@ -114,8 +164,7 @@ def explore(
     trap.register_handler(dump_partial)
     try:
         if cp.rank() == 0:
-            states = get_all_sequences(graph, platform, opts.max_seqs)
-            states = _dedup_terminal_states(states)
+            states = enumerate_schedules(graph, platform, opts.max_seqs)
             n = len(states)
         else:
             states, n = [], 0

@@ -26,6 +26,15 @@ from tenzing_tpu.core.sequence import Sequence
 from tenzing_tpu.core.state import Decision, ExecuteOp, State
 
 
+def _decisions(state: State, platform) -> List[Decision]:
+    """Native-accelerated decision enumeration with Python fallback (the two
+    agree exactly; see tests/test_native.py)."""
+    from tenzing_tpu.native import bridge
+
+    nat = bridge.try_decisions(state, platform)
+    return nat if nat is not None else state.get_decisions(platform)
+
+
 class Node:
     def __init__(
         self,
@@ -58,7 +67,7 @@ class Node:
         if self.expanded_ or self.is_terminal():
             self.expanded_ = True
             return
-        for d in self.state.get_decisions(platform):
+        for d in _decisions(self.state, platform):
             self.children.append(Node(self.state.apply(d), self.strategy, d, self))
         self.expanded_ = True
         if not self.children:
@@ -119,6 +128,11 @@ class Node:
                     break
                 node = rng.choice(node.children)
             return node, node.state.sequence
+        from tenzing_tpu.native import bridge
+
+        nat = bridge.try_rollout(self.state, platform, rng.getrandbits(63))
+        if nat is not None:
+            return self, nat
         state = self.state
         while not state.is_terminal():
             ds = state.get_decisions(platform)
