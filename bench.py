@@ -49,9 +49,11 @@ def main() -> int:
     bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, seed=0)
     bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
 
+    # impl_choice: the kernel menu (XLA gather vs Pallas vreg-gather) is part
+    # of the searched space alongside order and lane assignment
     g = Graph()
-    g.start_then(SpMVCompound())
-    g.then_finish(SpMVCompound())
+    g.start_then(SpMVCompound(impl_choice=True))
+    g.then_finish(SpMVCompound(impl_choice=True))
     plat = Platform.make_n_lanes(2)
     ex = TraceExecutor(plat, bufs)
     bench = EmpiricalBenchmarker(ex)

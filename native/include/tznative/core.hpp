@@ -71,7 +71,11 @@ struct State {
   std::vector<int32_t> bindings;
   std::vector<Item> seq;
 
-  bool executed(int32_t op) const;
+  bool executed(int32_t op) const {
+    for (const Item& it : seq)
+      if (it.tag == TAG_EXEC && it.a == op) return true;
+    return false;
+  }
   bool is_terminal(const Graph& g) const { return executed(g.finish); }
 };
 

@@ -140,22 +140,4 @@ int64_t tz_enum_fetch(int32_t* out, int64_t cap) {
   return need;
 }
 
-// Canonical equivalence key of a sequence (with_bindings=0) or full state
-// (with_bindings=1), as raw bytes.  Returns byte length / -needed / TZ_ERROR.
-int64_t tz_canonical_key(void* gp, const int32_t* bindings, int32_t seq_len,
-                         const int32_t* seq, int32_t with_bindings, char* out,
-                         int64_t cap) {
-  try {
-    const Graph& g = *static_cast<Graph*>(gp);
-    State st = make_state(g, bindings, seq_len, seq);
-    std::string k = canonical_key(st, with_bindings != 0);
-    if ((int64_t)k.size() > cap) return -(int64_t)k.size();
-    std::memcpy(out, k.data(), k.size());
-    return (int64_t)k.size();
-  } catch (const std::exception& e) {
-    g_last_error = e.what();
-    return TZ_ERROR;
-  }
-}
-
 }  // extern "C"

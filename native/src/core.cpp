@@ -36,20 +36,18 @@ Graph Graph::build(int32_t n_ops, const int32_t* kinds_in, int32_t n_edges,
   return g;
 }
 
-bool State::executed(int32_t op) const {
-  for (const Item& it : seq)
-    if (it.tag == TAG_EXEC && it.a == op) return true;
-  return false;
-}
-
 // -- synchronizer -------------------------------------------------------------
 
 namespace {
 
-int seq_index_of_exec(const State& st, int32_t op) {
+// one-pass exec-position table (op id -> seq index, -1 = not executed); built
+// once per get_decisions/is_synced/make_syncs call so the per-predecessor
+// lookups are O(1) instead of O(|seq|)
+std::vector<int> exec_index(const Graph& g, const State& st) {
+  std::vector<int> idx(g.n, -1);
   for (size_t i = 0; i < st.seq.size(); ++i)
-    if (st.seq[i].tag == TAG_EXEC && st.seq[i].a == op) return (int)i;
-  return -1;
+    if (st.seq[i].tag == TAG_EXEC) idx[st.seq[i].a] = (int)i;
+  return idx;
 }
 
 // mirrors event_synchronizer.py _device_then_device_synced
@@ -107,12 +105,13 @@ bool is_bound_device(const Graph& g, const State& st, int32_t op) {
 
 }  // namespace
 
-bool is_synced(const Graph& g, const State& st, int32_t op) {
+bool is_synced_impl(const Graph& g, const State& st, int32_t op,
+                    const std::vector<int>& eidx) {
   bool op_device = is_bound_device(g, st, op);
   int32_t op_lane = op_device ? st.bindings[op] : -1;
   for (int32_t pred : g.preds[op]) {
     if (!is_bound_device(g, st, pred)) continue;  // host -> anything is free
-    int pi = seq_index_of_exec(st, pred);
+    int pi = eidx[pred];
     if (pi < 0) throw std::logic_error("is_synced: predecessor not executed");
     if (op_device) {
       if (!device_then_device_synced(st, st.bindings[pred], pi, op_lane))
@@ -124,7 +123,8 @@ bool is_synced(const Graph& g, const State& st, int32_t op) {
   return true;
 }
 
-std::vector<Item> make_syncs(const Graph& g, const State& st, int32_t op) {
+std::vector<Item> make_syncs_impl(const Graph& g, const State& st, int32_t op,
+                                  const std::vector<int>& eidx) {
   std::vector<Item> syncs;
   auto emit = [&syncs](const Item& s) {
     if (std::find(syncs.begin(), syncs.end(), s) == syncs.end())
@@ -135,7 +135,7 @@ std::vector<Item> make_syncs(const Graph& g, const State& st, int32_t op) {
   for (int32_t pred : g.preds[op]) {
     if (!is_bound_device(g, st, pred)) continue;
     int32_t pred_lane = st.bindings[pred];
-    int pi = seq_index_of_exec(st, pred);
+    int pi = eidx[pred];
     if (pi < 0) throw std::logic_error("make_syncs: predecessor not executed");
     if (op_device) {
       if (device_then_device_synced(st, pred_lane, pi, op_lane)) continue;
@@ -159,14 +159,22 @@ std::vector<Item> make_syncs(const Graph& g, const State& st, int32_t op) {
   return syncs;
 }
 
+bool is_synced(const Graph& g, const State& st, int32_t op) {
+  return is_synced_impl(g, st, op, exec_index(g, st));
+}
+
+std::vector<Item> make_syncs(const Graph& g, const State& st, int32_t op) {
+  return make_syncs_impl(g, st, op, exec_index(g, st));
+}
+
 // -- SDP stepping -------------------------------------------------------------
 
 std::vector<Item> get_decisions(const Graph& g, const State& st, int32_t n_lanes) {
   // frontier: ops not executed whose preds are all executed, in op-id order
   // (mirrors graph.py frontier over insertion-ordered vertices)
+  std::vector<int> eidx = exec_index(g, st);
   std::vector<bool> done(g.n, false);
-  for (const Item& it : st.seq)
-    if (it.tag == TAG_EXEC) done[it.a] = true;
+  for (int32_t v = 0; v < g.n; ++v) done[v] = eidx[v] >= 0;
   std::vector<Item> decisions;
   auto emit = [&decisions](const Item& d) {
     if (std::find(decisions.begin(), decisions.end(), d) == decisions.end())
@@ -182,7 +190,7 @@ std::vector<Item> get_decisions(const Graph& g, const State& st, int32_t n_lanes
       for (int32_t l = 0; l < n_lanes; ++l) emit({TAG_ASSIGN, v, l});
       continue;
     }
-    std::vector<Item> syncs = make_syncs(g, st, v);
+    std::vector<Item> syncs = make_syncs_impl(g, st, v, eidx);
     if (syncs.empty()) {
       emit({TAG_EXEC, v, g.kinds[v] == KIND_DEVICE ? st.bindings[v] : -1});
     } else {

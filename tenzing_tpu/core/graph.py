@@ -53,12 +53,17 @@ class Graph:
             self._canon[op.eq_key()] = op
         return self._canon[op.eq_key()]
 
-    def _vertex(self, op: OpBase) -> OpBase:
-        """Return the stored vertex object equal to ``op`` (O(1))."""
+    def vertex(self, op: OpBase) -> OpBase:
+        """Return the stored vertex object equal to ``op`` (O(1)) — the stored
+        object carries the current resource binding (e.g. after
+        clone_but_replace lane surgery)."""
         try:
             return self._canon[op.eq_key()]
         except KeyError:
             raise KeyError(f"op {op!r} not in graph") from None
+
+    # backward-compatible private alias
+    _vertex = vertex
 
     def then(self, a: OpBase, b: OpBase) -> OpBase:
         """Add edge a->b, inserting vertices as needed; returns b for chaining

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tenzing_tpu.core.graph import Graph
-from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+from tenzing_tpu.core.operation import ChoiceOp, CompoundOp, DeviceOp, OpBase
 
 
 # -- host-side matrix structures (reference coo_mat.hpp / csr_mat.hpp) -----------
@@ -240,6 +240,39 @@ class SpMVOp(DeviceOp):
         return {self._y: jnp.sum(vals * x[cols], axis=1)}
 
 
+class SpMVPallasOp(SpMVOp):
+    """ELL-slab SpMV via the Pallas masked vreg-gather kernel
+    (ops/spmv_pallas.py).  Falls back to the XLA gather (the parent op) when x
+    is too large for the in-kernel gather decomposition (see ops/spmv_pallas.py
+    hardware note) so the op is always valid; where both kernels apply, which
+    is faster is the solver's ChoiceOp question."""
+
+    def apply(self, bufs, ctx):
+        from tenzing_tpu.ops.spmv_pallas import ell_spmv_pallas, supports
+
+        vals, cols, x = bufs[self._vals], bufs[self._cols], bufs[self._x]
+        if not supports(x.shape[0]):
+            return super().apply(bufs, ctx)
+        return {self._y: ell_spmv_pallas(vals, cols, x)}
+
+
+class SpMVImplChoice(ChoiceOp):
+    """Implementation menu for one SpMV: XLA-gather vs Pallas vreg-gather
+    (reference ChoiceOp, operation.hpp:90-93; the scheduler replaces it via a
+    ChooseOp decision, state.cpp:61-65)."""
+
+    def __init__(self, name: str, x: str, y: str, vals: str, cols: str):
+        super().__init__(name)
+        self._args = (x, y, vals, cols)
+
+    def choices(self) -> List[OpBase]:
+        x, y, vals, cols = self._args
+        return [
+            SpMVOp(self.name() + ".xla", x, y, vals, cols),
+            SpMVPallasOp(self.name() + ".pallas", x, y, vals, cols),
+        ]
+
+
 class Scatter(DeviceOp):
     """Gather owned x entries into a contiguous send buffer (reference Scatter,
     ops_spmv.cuh:194-215)."""
@@ -298,17 +331,23 @@ class LocalExchange(DeviceOp):
 class SpMVCompound(CompoundOp):
     """The whole SpMV iteration as one compound op (reference SpMV CompoundOp,
     ops_spmv.cuh:306-436): start -> {local spmv, scatter -> exchange}; exchange
-    -> remote spmv; {local, remote} -> add -> finish."""
+    -> remote spmv; {local, remote} -> add -> finish.
 
-    def __init__(self, name: str = "spmv"):
+    With ``impl_choice=True`` the two SpMV kernels become implementation
+    ChoiceOps (XLA gather vs Pallas vreg-gather) and the solver searches the
+    kernel menu alongside order and lane assignment."""
+
+    def __init__(self, name: str = "spmv", impl_choice: bool = False):
         super().__init__(name)
+        self._impl_choice = impl_choice
 
     def graph(self) -> Graph:
         g = Graph()
-        yl = SpMVOp("spmv_local", "x_local", "y_local", "A_loc_vals", "A_loc_cols")
+        mk = SpMVImplChoice if self._impl_choice else SpMVOp
+        yl = mk("spmv_local", "x_local", "y_local", "A_loc_vals", "A_loc_cols")
         scatter = Scatter("scatter", "x_local", "send_idx", "send_buf")
         exch = LocalExchange("exchange", "send_buf", "x_remote")
-        yr = SpMVOp("spmv_remote", "x_remote", "y_remote", "A_rem_vals", "A_rem_cols")
+        yr = mk("spmv_remote", "x_remote", "y_remote", "A_rem_vals", "A_rem_cols")
         add = VectorAdd("y_add", "y_local", "y_remote", "y")
         g.start_then(yl)
         g.start_then(scatter)

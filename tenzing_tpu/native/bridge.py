@@ -62,7 +62,7 @@ def _sources_mtime() -> float:
     newest = 0.0
     for root, _dirs, files in os.walk(_NATIVE_DIR):
         for f in files:
-            if f.endswith((".cpp", ".hpp")):
+            if f.endswith((".cpp", ".hpp")) or f == "Makefile":
                 newest = max(newest, os.path.getmtime(os.path.join(root, f)))
     return newest
 
@@ -180,16 +180,7 @@ class LoweredGraph:
         self.index: Dict[Tuple, int] = {}
         kinds = []
         for i, v in enumerate(self.vertices):
-            if isinstance(v, Start):
-                kinds.append(KIND_START)
-            elif isinstance(v, Finish):
-                kinds.append(KIND_FINISH)
-            elif isinstance(v, (DeviceOp, BoundDeviceOp)):
-                kinds.append(KIND_DEVICE)
-            elif isinstance(v, CpuOp):
-                kinds.append(KIND_HOST)
-            else:
-                raise NotLowerable(f"vertex {v!r} (expand compound/choice ops first)")
+            kinds.append(_kind_of(v))
             self.index[v.eq_key()] = i
         edges: List[int] = []
         n_edges = 0
@@ -273,19 +264,65 @@ class LoweredGraph:
 
     def decision_of(self, tag: int, a: int, b: int, graph: Graph) -> Decision:
         if tag == TAG_ASSIGN:
-            v = graph._vertex(self.vertices[a])
+            v = graph.vertex(self.vertices[a])
             assert isinstance(v, DeviceOp) and not isinstance(v, BoundDeviceOp), v
             return AssignLane(v, Lane(b))
         if tag == TAG_EXEC:
             # the graph's stored vertex carries the current binding
-            v = graph._vertex(self.vertices[a])
+            v = graph.vertex(self.vertices[a])
             assert isinstance(v, BoundOp), v
             return ExecuteOp(v)
         return ExecuteOp(self.item_to_op(tag, a, b))
 
 
+# Structural cache: MCTS/DFS lower thousands of States whose graphs are
+# re-bound clones of a handful of structures (eq_key is binding-insensitive),
+# so the native handle + vertex table are reusable; only bindings_of /
+# lower_sequence vary per call.
+_LG_CACHE: Dict[Tuple, "LoweredGraph"] = {}
+_LG_CACHE_LOCK = threading.Lock()
+_LG_CACHE_MAX = 128
+
+
+def _kind_of(v: OpBase) -> int:
+    if isinstance(v, Start):
+        return KIND_START
+    if isinstance(v, Finish):
+        return KIND_FINISH
+    if isinstance(v, (DeviceOp, BoundDeviceOp)):
+        return KIND_DEVICE
+    if isinstance(v, CpuOp):
+        return KIND_HOST
+    raise NotLowerable(f"vertex {v!r} (expand compound/choice ops first)")
+
+
+def lowered_graph_for(graph: Graph) -> "LoweredGraph":
+    """The cached lowering of this graph's structure (binding-insensitive)."""
+    verts = graph.vertices()
+    idx = {v.eq_key(): i for i, v in enumerate(verts)}
+    key = (
+        tuple(v.eq_key() for v in verts),
+        tuple(_kind_of(v) for v in verts),
+        tuple(idx[s.eq_key()] for v in verts for s in graph.succs(v)),
+    )
+    with _LG_CACHE_LOCK:
+        lg = _LG_CACHE.get(key)
+        if lg is None:
+            if len(_LG_CACHE) >= _LG_CACHE_MAX:
+                _LG_CACHE.clear()
+            lg = LoweredGraph(graph)
+            _LG_CACHE[key] = lg
+        return lg
+
+
+def _lanes_are_dense(platform) -> bool:
+    """The native core enumerates lane indices 0..n-1; bail out (to the Python
+    path) for platforms whose lane ids aren't exactly that."""
+    return [l.id for l in platform.lanes] == list(range(len(platform.lanes)))
+
+
 def _lower_state(state: State):
-    lg = LoweredGraph(state.graph)
+    lg = lowered_graph_for(state.graph)
     bindings = lg.bindings_of(state.graph)
     seq_len, seq_arr = lg.lower_sequence(state.sequence)
     return lg, bindings, seq_len, seq_arr
@@ -296,7 +333,7 @@ def _lower_state(state: State):
 
 def try_decisions(state: State, platform) -> Optional[List[Decision]]:
     """Native get_decisions, or None when native is unavailable/not applicable."""
-    if _load() is None:
+    if _load() is None or not _lanes_are_dense(platform):
         return None
     try:
         lg, bindings, seq_len, seq_arr = _lower_state(state)
@@ -314,6 +351,8 @@ def try_decisions(state: State, platform) -> Optional[List[Decision]]:
         n = lg._lib.tz_decisions(
             lg.handle, len(platform.lanes), bindings, seq_len, seq_arr, out, -n
         )
+        if n < 0:
+            raise NativeError(lg._lib.tz_last_error().decode())
     return [
         lg.decision_of(out[3 * i], out[3 * i + 1], out[3 * i + 2], state.graph)
         for i in range(n // 3)
@@ -322,7 +361,7 @@ def try_decisions(state: State, platform) -> Optional[List[Decision]]:
 
 def try_rollout(state: State, platform, seed: int) -> Optional[Sequence]:
     """Native random playout to a terminal sequence, or None."""
-    if _load() is None:
+    if _load() is None or not _lanes_are_dense(platform):
         return None
     try:
         lg, bindings, seq_len, seq_arr = _lower_state(state)
@@ -342,6 +381,8 @@ def try_rollout(state: State, platform, seed: int) -> Optional[Sequence]:
             lg.handle, len(platform.lanes), bindings, seq_len, seq_arr,
             seed & 0xFFFFFFFFFFFFFFFF, out, -n,
         )
+        if n < 0:
+            raise NativeError(lg._lib.tz_last_error().decode())
     return lg.items_to_sequence(out, n // 3)
 
 
@@ -349,10 +390,10 @@ def try_enumerate(
     graph: Graph, platform, max_seqs: int, dedup_terminals: bool = True
 ) -> Optional[List[State]]:
     """Native exhaustive enumeration -> States with lane-bound graphs, or None."""
-    if _load() is None:
+    if _load() is None or not _lanes_are_dense(platform):
         return None
     try:
-        lg = LoweredGraph(graph)
+        lg = lowered_graph_for(graph)
     except NotLowerable:
         return None
     n_lanes = len(platform.lanes)

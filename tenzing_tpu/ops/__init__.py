@@ -1,0 +1,12 @@
+"""Pallas device kernels for the hot ops.
+
+Each kernel has a Pallas TPU path and an ``interpret=True`` path so the same
+code runs in CPU tests (SURVEY.md §4: device tests are opt-in; unit tests run
+anywhere).  Kernel selection is exposed to the *scheduler* as implementation
+ChoiceOps in the workload models (reference ChoiceOp, operation.hpp:90-93) —
+picking the faster kernel is part of the searched schedule space.
+"""
+
+from tenzing_tpu.ops.spmv_pallas import ell_spmv_pallas
+
+__all__ = ["ell_spmv_pallas"]

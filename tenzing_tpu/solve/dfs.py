@@ -112,21 +112,41 @@ def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000) -> List[S
     Structural decisions (compound expansion, implementation choices) are
     resolved eagerly into graph variants; each variant's order x lane space is
     enumerated by the native (C++) core when available, else by the Python
-    path.  Note the cap counts *deduplicated* terminals on the native path and
-    raw terminals on the Python path (the native behaviour is strictly more
-    productive)."""
+    path.  The ``max_seqs`` budget is fair-shared across variants (a huge first
+    variant must not starve the others out of the search entirely); unused
+    share flows to later variants.  Note the cap counts *deduplicated*
+    terminals on the native path and raw terminals on the Python path (the
+    native behaviour is strictly more productive)."""
+    import sys
+
     from tenzing_tpu.native import bridge
 
+    variants = structural_variants(graph)
     out: List[State] = []
-    for g in structural_variants(graph):
-        budget = max_seqs - len(out)
-        if budget <= 0:
+    for k, g in enumerate(variants):
+        remaining = max_seqs - len(out)
+        if remaining <= 0:
+            print(
+                f"tenzing-tpu: dfs budget exhausted; {len(variants) - k} structural "
+                "variant(s) not enumerated (raise max_seqs)",
+                file=sys.stderr,
+            )
             break
-        nat = bridge.try_enumerate(g, platform, budget, dedup_terminals=True)
+        share = -(-remaining // (len(variants) - k))  # ceil fair share
+        nat = bridge.try_enumerate(g, platform, share, dedup_terminals=True)
         if nat is not None:
-            out.extend(nat)
+            truncated = len(nat) >= share
         else:
-            out.extend(_dedup_terminal_states(get_all_sequences(g, platform, budget)))
+            raw = get_all_sequences(g, platform, share)
+            truncated = len(raw) >= share  # raw count, before dedup shrinks it
+            nat = _dedup_terminal_states(raw)
+        if truncated and k + 1 < len(variants):
+            print(
+                f"tenzing-tpu: dfs variant {k} truncated at its fair share "
+                f"({share} schedules)",
+                file=sys.stderr,
+            )
+        out.extend(nat)
     return out
 
 
