@@ -1,13 +1,19 @@
 #!/usr/bin/env python
-"""Driver benchmark: searched schedule vs naive sequential ordering on the
-distributed-SpMV iteration (reference config: m=150000 rows, nnz=10*m, band
-matrix, 2 lanes — spmv_run_strategy.cuh:44-47; protocol BASELINE.md).
+"""Driver benchmark: searched schedule vs naive sequential ordering.
+
+Workloads (``--workload``):
+* ``spmv`` (default, the headline metric): distributed-SpMV iteration
+  (reference config: m=150000 rows, nnz=10*m, band matrix, 2 lanes —
+  spmv_run_strategy.cuh:44-47; protocol BASELINE.md).
+* ``attn``: single-chip blockwise (flash) attention over a long context —
+  the kernel menu (XLA vs Pallas MXU) plus order x lane space.
 
 The search is anytime and starts from the naive incumbent: MCTS (FastMin
-strategy) spends a fixed compile budget exploring the order x lane space; the
-reported best is min over {naive} + searched candidates, so vs_baseline >= 1 and
-exceeds 1 exactly when the search discovers a schedule faster than the naive
-sequential order.
+strategy) spends a fixed compile budget exploring the order x lane x kernel
+space; the reported best is min over {naive} + searched candidates, so
+vs_baseline >= 1 and exceeds 1 exactly when the search discovers a schedule
+faster than the naive sequential order (all ops on one lane, first kernel
+choice).
 
 Prints ONE JSON line:
   {"metric": ..., "value": <best pct50, us>, "unit": "us",
@@ -22,10 +28,52 @@ import sys
 import time
 
 
+def build_spmv(args):
+    import jax.numpy as jnp
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+
+    m = args.m if args.m is not None else (512 if args.smoke else 150_000)
+    bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, seed=0)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    # impl_choice: the kernel menu (XLA gather vs Pallas vreg-gather) is part
+    # of the searched space alongside order and lane assignment
+    g = Graph()
+    g.start_then(SpMVCompound(impl_choice=True))
+    g.then_finish(SpMVCompound(impl_choice=True))
+    return g, bufs, f"spmv_iter_pct50_searched_m{m}"
+
+
+def build_attn(args):
+    import jax.numpy as jnp
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.models.ring_attention import (
+        BlockedAttention,
+        RingAttnArgs,
+        make_blocked_buffers,
+    )
+
+    if args.smoke:
+        aargs = RingAttnArgs(n_devices=4, batch=1, seq_local=16, head_dim=8)
+    else:
+        # 8k context in 8 blocks of 1024, head dim 128
+        aargs = RingAttnArgs(n_devices=8, batch=4, seq_local=1024, head_dim=128)
+    bufs, _ = make_blocked_buffers(aargs, seed=0)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    g = Graph()
+    g.start_then(BlockedAttention(aargs, impl_choice=True))
+    g.then_finish(BlockedAttention(aargs, impl_choice=True))
+    n_ctx = aargs.n_devices * aargs.seq_local
+    return g, bufs, f"attn_blockwise_pct50_searched_n{n_ctx}"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny CPU config")
-    ap.add_argument("--m", type=int, default=None, help="matrix rows")
+    ap.add_argument("--workload", choices=("spmv", "attn"), default="spmv")
+    ap.add_argument("--m", type=int, default=None, help="matrix rows (spmv)")
     ap.add_argument("--mcts-iters", type=int, default=10, help="MCTS iterations (compile budget)")
     ap.add_argument("--iters", type=int, default=20, help="measurements per schedule")
     args = ap.parse_args()
@@ -34,33 +82,23 @@ def main() -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
-    from tenzing_tpu.core.graph import Graph
     from tenzing_tpu.core.platform import Platform
     from tenzing_tpu.core.state import State
-    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
     from tenzing_tpu.runtime.executor import TraceExecutor
     from tenzing_tpu.solve.mcts import MctsOpts, explore
     from tenzing_tpu.solve.mcts.strategies import FastMin
 
-    m = args.m if args.m is not None else (512 if args.smoke else 150_000)
-    bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, seed=0)
-    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
-
-    # impl_choice: the kernel menu (XLA gather vs Pallas vreg-gather) is part
-    # of the searched space alongside order and lane assignment
-    g = Graph()
-    g.start_then(SpMVCompound(impl_choice=True))
-    g.then_finish(SpMVCompound(impl_choice=True))
+    g, bufs, metric = (build_spmv if args.workload == "spmv" else build_attn)(args)
     plat = Platform.make_n_lanes(2)
     ex = TraceExecutor(plat, bufs)
     bench = EmpiricalBenchmarker(ex)
     opts = BenchOpts(n_iters=max(5, args.iters), target_secs=0.002 if args.smoke else 0.01)
 
-    # naive incumbent: every device op on lane 0, topological order — the
-    # reference's "sequential ordering on one stream" baseline (BASELINE.json)
+    # naive incumbent: every device op on lane 0, topological order, first
+    # kernel choice — the reference's "sequential ordering on one stream"
+    # baseline (BASELINE.json)
     naive_plat = Platform.make_n_lanes(1)
     naive_state = State(g)
     while not naive_state.is_terminal():
@@ -69,7 +107,7 @@ def main() -> int:
     naive = bench.benchmark(naive_state.sequence, opts)
     sys.stderr.write(f"naive: pct50={naive.pct50*1e6:.1f}us (wall {time.time()-t0:.0f}s)\n")
 
-    # directed search over the 2-lane order x lane space
+    # directed search over the 2-lane order x lane x kernel space
     t0 = time.time()
     res = explore(
         g,
@@ -91,7 +129,7 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": "spmv_iter_pct50_searched_m%d" % m,
+                "metric": metric,
                 "value": round(value_us, 2),
                 "unit": "us",
                 "vs_baseline": round(vs, 4),

@@ -1,0 +1,332 @@
+"""Ring attention: long-context sequence parallelism as a searchable op DAG.
+
+The reference has no attention (SURVEY.md §2.5: TP/PP/ring-attention absent; the
+op-DAG must nonetheless *express* such programs — "a compound op whose subgraph
+is a ring of permute+compute steps is exactly ring-attention-shaped").  This
+model is that compound: the structural sibling of the halo exchange
+(models/halo.py — neighbor ppermute + pack/unpack) and of the SpMV remote
+exchange, with the same searchable comm/compute-overlap shape as the
+reference's pack->Isend->compute pipelines (ops_halo_exchange.cu:33-257).
+
+Design (blockwise ring attention, double-buffered):
+
+* the sequence axis is sharded over mesh axis ``"sp"``: each device holds local
+  queries Q and one K/V block; K/V blocks rotate around the ring via
+  ``lax.ppermute`` while flash-style online-softmax state (acc, m, l) folds in
+  one block per step;
+* K/V are **double-buffered** (kv0/kv1 ping-pong): ``rotate_s`` reads the
+  current buffer and writes the other, so ``attn_s`` and ``rotate_s`` are
+  independent in the DAG — computing block s can overlap rotating block s+1.
+  How aggressively they overlap (lane assignment, ordering, sync placement) is
+  the solver's schedule space, exactly the reference's premise;
+* the WAR edge ``attn_{s-1} -> rotate_s`` keeps the buffer being overwritten
+  free (its reader has executed) so every topological order is correct under
+  the executor's SSA buffer semantics;
+* m and l are carried broadcast to Q's (b, n, d) shape so the Pallas kernel
+  works on uniform tiles (ops/attention_pallas.py).
+
+The per-step block update has an implementation ChoiceOp: plain XLA einsums vs
+the Pallas MXU kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import ChoiceOp, CompoundOp, DeviceOp, OpBase
+
+AXIS = "sp"
+
+
+@dataclass(frozen=True)
+class RingAttnArgs:
+    n_devices: int  # ring size (mesh axis "sp" extent)
+    batch: int = 1
+    seq_local: int = 128  # queries per device
+    head_dim: int = 128
+    dtype: str = "float32"
+
+    @property
+    def scale(self) -> float:
+        return 1.0 / float(np.sqrt(self.head_dim))
+
+
+def _kv(s: int) -> Tuple[str, str]:
+    """Buffer names holding the K/V block consumed at ring step ``s``."""
+    return f"K{s % 2}", f"V{s % 2}"
+
+
+class AttnStep(DeviceOp):
+    """Fold ring step ``s``'s K/V block into the online-softmax state via XLA
+    einsums (the reference-shape 'plain' implementation)."""
+
+    def __init__(self, name: str, s: int, args: RingAttnArgs):
+        super().__init__(name)
+        self._s = s
+        self._args = args
+
+    def reads(self):
+        k, v = _kv(self._s)
+        return ["Q", k, v, "acc", "m_run", "l_run"]
+
+    def writes(self):
+        return ["acc", "m_run", "l_run"]
+
+    def _update(self, q, k, v, acc, m, l):
+        import jax.numpy as jnp
+
+        s_ = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32)
+        s_ = s_ * self._args.scale
+        m_blk = jnp.max(s_, axis=2, keepdims=True)  # (b, n, 1)
+        m_new = jnp.maximum(m, jnp.broadcast_to(m_blk, m.shape))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s_ - m_new[..., :1])
+        l_new = l * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=2, keepdims=True), l.shape
+        )
+        acc_new = acc * alpha + jnp.einsum(
+            "bqk,bkd->bqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        ).astype(acc.dtype)
+        return acc_new, m_new, l_new
+
+    def apply(self, bufs, ctx):
+        k, v = _kv(self._s)
+        acc, m, l = self._update(
+            bufs["Q"], bufs[k], bufs[v], bufs["acc"], bufs["m_run"], bufs["l_run"]
+        )
+        return {"acc": acc, "m_run": m, "l_run": l}
+
+
+class AttnStepPallas(AttnStep):
+    """Same update via the Pallas MXU kernel (ops/attention_pallas.py)."""
+
+    def _update(self, q, k, v, acc, m, l):
+        from tenzing_tpu.ops.attention_pallas import attn_block_pallas
+
+        return attn_block_pallas(q, k, v, acc, m, l, self._args.scale)
+
+
+class AttnStepChoice(ChoiceOp):
+    """Implementation menu for one ring step: XLA einsums vs Pallas kernel."""
+
+    def __init__(self, name: str, s: int, args: RingAttnArgs):
+        super().__init__(name)
+        self._s = s
+        self._args = args
+
+    def choices(self) -> List[OpBase]:
+        return [
+            AttnStep(self.name() + ".xla", self._s, self._args),
+            AttnStepPallas(self.name() + ".pallas", self._s, self._args),
+        ]
+
+
+class RotateKV(DeviceOp):
+    """Send the step-``s`` K/V block one hop around the ring into the *other*
+    buffer pair (double-buffering: the write never clobbers what step ``s``
+    reads).  The ICI analog of the halo Exchange op (models/halo.py) and of the
+    reference's Isend/Irecv pairs (ops_mpi.hpp:17-146)."""
+
+    def __init__(self, name: str, s: int):
+        super().__init__(name)
+        self._s = s
+
+    def reads(self):
+        return list(_kv(self._s))
+
+    def writes(self):
+        return list(_kv(self._s + 1))
+
+    def apply(self, bufs, ctx):
+        import jax
+
+        n = jax.lax.axis_size(AXIS)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_in, v_in = _kv(self._s)
+        k_out, v_out = _kv(self._s + 1)
+        return {
+            k_out: jax.lax.ppermute(bufs[k_in], AXIS, perm),
+            v_out: jax.lax.ppermute(bufs[v_in], AXIS, perm),
+        }
+
+
+class FinalizeAttn(DeviceOp):
+    """O = acc / l (the denominator division deferred past the ring)."""
+
+    def __init__(self, name: str = "attn_finalize"):
+        super().__init__(name)
+
+    def reads(self):
+        return ["acc", "l_run"]
+
+    def writes(self):
+        return ["O"]
+
+    def apply(self, bufs, ctx):
+        return {"O": bufs["acc"] / bufs["l_run"]}
+
+
+class RingAttention(CompoundOp):
+    """The whole ring as one compound op: n_devices attn steps chained through
+    the softmax state, n_devices-1 rotates chained through the kv buffers, WAR
+    edges attn_{s-1} -> rotate_s, finalize at the end."""
+
+    def __init__(self, args: RingAttnArgs, name: str = "ring_attention",
+                 impl_choice: bool = False):
+        super().__init__(name)
+        self._args = args
+        self._impl_choice = impl_choice
+
+    def args(self) -> RingAttnArgs:
+        return self._args
+
+    def graph(self) -> Graph:
+        g = Graph()
+        n = self._args.n_devices
+        mk = AttnStepChoice if self._impl_choice else AttnStep
+        attns = [mk(f"attn_{s}", s, self._args) for s in range(n)]
+        rots = [RotateKV(f"rotate_{s}", s) for s in range(n - 1)]
+        g.start_then(attns[0])
+        for s in range(1, n):
+            g.then(attns[s - 1], attns[s])
+            g.then(rots[s - 1], attns[s])
+        for s in range(1, n - 1):
+            g.then(rots[s - 1], rots[s])
+        if rots:
+            g.start_then(rots[0])
+        for s in range(1, n - 1):
+            # WAR: rotate_s overwrites the buffer attn_{s-1} reads
+            g.then(attns[s - 1], rots[s])
+        fin = FinalizeAttn()
+        g.then(attns[-1], fin)
+        g.then_finish(fin)
+        return g
+
+
+class BlockAttnStep(AttnStep):
+    """Single-device variant: fold K/V block ``s`` *sliced from the resident
+    K/V* into the state (blockwise/flash attention without the ring — the
+    1-device degenerate case of sequence parallelism, long context in HBM)."""
+
+    def reads(self):
+        return ["Q", "K", "V", "acc", "m_run", "l_run"]
+
+    def apply(self, bufs, ctx):
+        import jax.lax as lax
+
+        blk = self._args.seq_local
+        k = lax.dynamic_slice_in_dim(bufs["K"], self._s * blk, blk, 1)
+        v = lax.dynamic_slice_in_dim(bufs["V"], self._s * blk, blk, 1)
+        acc, m, l = self._update(
+            bufs["Q"], k, v, bufs["acc"], bufs["m_run"], bufs["l_run"]
+        )
+        return {"acc": acc, "m_run": m, "l_run": l}
+
+
+class BlockAttnStepPallas(BlockAttnStep):
+    """Blocked step with the Pallas MXU kernel update."""
+
+    _update = AttnStepPallas._update
+
+
+class BlockAttnChoice(ChoiceOp):
+    def __init__(self, name: str, s: int, args: RingAttnArgs):
+        super().__init__(name)
+        self._s = s
+        self._args = args
+
+    def choices(self) -> List[OpBase]:
+        return [
+            BlockAttnStep(self.name() + ".xla", self._s, self._args),
+            BlockAttnStepPallas(self.name() + ".pallas", self._s, self._args),
+        ]
+
+
+class BlockedAttention(CompoundOp):
+    """Single-device blockwise attention over ``n_blocks`` K/V blocks: the attn
+    steps chain through the softmax state; block loads overlap on lanes; the
+    per-step kernel is a ChoiceOp when ``impl_choice``.  ``args.n_devices``
+    is reused as the block count (no mesh involved)."""
+
+    def __init__(self, args: RingAttnArgs, name: str = "blocked_attention",
+                 impl_choice: bool = False):
+        super().__init__(name)
+        self._args = args
+        self._impl_choice = impl_choice
+
+    def args(self) -> RingAttnArgs:
+        return self._args
+
+    def graph(self) -> Graph:
+        g = Graph()
+        n = self._args.n_devices
+        mk = BlockAttnChoice if self._impl_choice else BlockAttnStep
+        attns = [mk(f"attn_{s}", s, self._args) for s in range(n)]
+        g.start_then(attns[0])
+        for s in range(1, n):
+            g.then(attns[s - 1], attns[s])
+        fin = FinalizeAttn()
+        g.then(attns[-1], fin)
+        g.then_finish(fin)
+        return g
+
+
+def make_blocked_buffers(
+    args: RingAttnArgs, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """(buffers, expected O) for single-device blockwise attention;
+    ``args.n_devices`` K/V blocks of ``seq_local`` each, resident in HBM."""
+    bufs, _specs, want = make_ring_buffers(args, seed=seed)
+    out = {
+        "Q": bufs["Q"],
+        "K": bufs["K0"],
+        "V": bufs["V0"],
+        "acc": bufs["acc"],
+        "m_run": bufs["m_run"],
+        "l_run": bufs["l_run"],
+        "O": bufs["O"],
+    }
+    return out, want
+
+
+def make_ring_buffers(
+    args: RingAttnArgs, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
+    """(buffers, partition specs, expected O) for a ring over ``args.n_devices``
+    shards.  Expected O is full (global) softmax attention computed densely on
+    the host, laid out in the same sp-sharded order as the device buffers."""
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(seed)
+    b, nl, d, nsp = args.batch, args.seq_local, args.head_dim, args.n_devices
+    n = nl * nsp
+    dt = np.dtype(args.dtype)
+    q = rng.standard_normal((b, n, d)).astype(dt)
+    k = rng.standard_normal((b, n, d)).astype(dt)
+    v = rng.standard_normal((b, n, d)).astype(dt)
+    # dense reference
+    s_ = np.einsum("bqd,bkd->bqk", q.astype(np.float64), k.astype(np.float64))
+    s_ *= args.scale
+    p = np.exp(s_ - s_.max(axis=2, keepdims=True))
+    p /= p.sum(axis=2, keepdims=True)
+    want = np.einsum("bqk,bkd->bqd", p, v.astype(np.float64)).astype(np.float32)
+
+    shape = (b, n, d)
+    bufs = {
+        "Q": q,
+        "K0": k,
+        "V0": v,
+        "K1": np.zeros_like(k),
+        "V1": np.zeros_like(v),
+        "acc": np.zeros(shape, np.float32),
+        "m_run": np.full(shape, -1e30, np.float32),
+        "l_run": np.zeros(shape, np.float32),
+        "O": np.zeros(shape, np.float32),
+    }
+    spec = P(None, AXIS, None)
+    specs = {name: spec for name in bufs}
+    return bufs, specs, want

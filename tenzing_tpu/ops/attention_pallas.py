@@ -1,0 +1,111 @@
+"""Pallas flash-attention block kernel: one online-softmax update step.
+
+The MXU workhorse of the ring-attention workload (models/ring_attention.py):
+given local queries Q and one K/V block of the ring, fold the block into the
+running (acc, m, l) online-softmax state:
+
+    s     = Q K^T * scale          (MXU)
+    m'    = max(m, rowmax(s))
+    alpha = exp(m - m')
+    p     = exp(s - m')
+    l'    = l * alpha + rowsum(p)
+    acc'  = acc * alpha + p V      (MXU)
+
+State tensors m and l are carried broadcast to (b, n, d) — same shape/layout as
+acc — so every in-kernel operand is a clean 2D (n, d) or (n, nkv) tile (no
+lane<->sublane transposes, no last-dim-1 blocks; see ops/spmv_pallas.py for the
+Mosaic layout constraints that motivate this).
+
+The kernel grid runs over the batch dimension; one program folds one batch
+element's whole block — Q/K/V blocks of ring attention are already VMEM-sized
+by construction (n_local x d per step).
+
+``interpret=True`` (automatic off-TPU) runs the same kernel in the Pallas
+interpreter for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tenzing_tpu.ops.common import out_struct
+
+
+def _attn_block_kernel(scale, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+                       acc_out, m_out, l_out):
+    q = q_ref[0]  # (n, d)
+    k = k_ref[0]  # (nkv, d)
+    v = v_ref[0]
+    m_old = m_ref[0]  # (n, d) broadcast copies of the running row max
+    l_old = l_ref[0]
+    acc_old = acc_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (n, nkv)
+    m_blk = jnp.max(s, axis=1, keepdims=True)  # (n, 1)
+    m_new = jnp.maximum(m_old, jnp.broadcast_to(m_blk, m_old.shape))
+    alpha = jnp.exp(m_old - m_new)
+    p = jnp.exp(s - m_new[:, :1])  # (n, nkv)
+    l_new = l_old * alpha + jnp.broadcast_to(
+        jnp.sum(p, axis=1, keepdims=True), l_old.shape
+    )
+    acc_new = acc_old * alpha + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    acc_out[0] = acc_new.astype(acc_out.dtype)
+    m_out[0] = m_new
+    l_out[0] = l_new
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def attn_block_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    acc: jax.Array,
+    m: jax.Array,
+    l: jax.Array,
+    scale: float,
+    *,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fold one K/V block into the online-softmax state; returns (acc', m', l').
+
+    Shapes: q (b, n, d); k/v (b, nkv, d); acc/m/l (b, n, d) with m/l broadcast
+    along the last axis.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, n, d = q.shape
+    nkv = k.shape[1]
+    # tile the (row-independent) update over query blocks so VMEM holds one
+    # q/state tile + the whole K/V block, never all n queries at once
+    bq = n if n <= 512 else 512
+    if n % bq:  # fall back to untiled for ragged n (small cases only)
+        bq = n
+    qblk = pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))
+    kvblk = pl.BlockSpec((1, nkv, d), lambda i, j: (i, 0, 0))
+    specs_in = [qblk, kvblk, kvblk, qblk, qblk, qblk]
+    operands = (q, k, v, acc, m, l)
+    out_shape = [
+        out_struct((b, n, d), acc.dtype, *operands),
+        out_struct((b, n, d), m.dtype, *operands),
+        out_struct((b, n, d), l.dtype, *operands),
+    ]
+    specs_out = [qblk, qblk, qblk]
+    kernel = functools.partial(_attn_block_kernel, float(scale))
+    return tuple(
+        pl.pallas_call(
+            kernel,
+            grid=(b, n // bq),
+            in_specs=specs_in,
+            out_specs=specs_out,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(q, k, v, acc, m, l)
+    )

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from tenzing_tpu.ops.common import out_struct
+
 LANES = 128
 
 # n/128 vregs above which the masked-gather sweep is clearly worse than the XLA
@@ -107,7 +109,7 @@ def ell_spmv_pallas(
             pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((block_m, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((m_pad, 1), vals.dtype),
+        out_shape=out_struct((m_pad, 1), vals.dtype, vals, cols, xp),
         interpret=interpret,
     )(vals, cols, xp.reshape(1, n_pad))
     return y[:m, 0]

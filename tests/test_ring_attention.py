@@ -1,0 +1,121 @@
+"""Ring attention: DAG shape, schedule search, and sharded numerics vs dense
+attention (the long-context workload; models/ring_attention.py)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.models.ring_attention import (
+    RingAttention,
+    RingAttnArgs,
+    make_ring_buffers,
+)
+from tenzing_tpu.runtime.executor import TraceExecutor
+from tenzing_tpu.solve.dfs import get_all_sequences
+
+
+def _graph(args, impl_choice=False):
+    g = Graph()
+    g.start_then(RingAttention(args, impl_choice=impl_choice))
+    g.then_finish(RingAttention(args, impl_choice=impl_choice))
+    return g
+
+
+def _mesh(nsp):
+    devs = np.array(jax.devices()[:nsp])
+    return Mesh(devs, ("sp",))
+
+
+class TestDagShape:
+    def test_rotate_overlaps_compute(self):
+        """rotate_s and attn_s must be DAG-independent (the searched overlap)."""
+        args = RingAttnArgs(n_devices=4)
+        g = RingAttention(args).graph()
+        by_name = {v.name(): v for v in g.vertices()}
+        for s in range(3):
+            a, r = by_name[f"attn_{s}"], by_name[f"rotate_{s}"]
+            assert r not in g.succs(a) and a not in g.succs(r)
+
+    def test_war_edge_protects_double_buffer(self):
+        """attn_{s-1} -> rotate_s: the buffer rotate_s overwrites has been read."""
+        args = RingAttnArgs(n_devices=4)
+        g = RingAttention(args).graph()
+        by_name = {v.name(): v for v in g.vertices()}
+        for s in range(1, 3):
+            assert by_name[f"rotate_{s}"] in g.succs(by_name[f"attn_{s - 1}"])
+
+    def test_schedule_space_is_nontrivial(self):
+        args = RingAttnArgs(n_devices=4)
+        plat = Platform.make_n_lanes(2)
+        seqs = get_all_sequences(_graph(args), plat, max_seqs=50)
+        assert len(seqs) > 1  # order x lane freedom exists
+
+
+class TestNumerics:
+    @pytest.mark.parametrize("nsp", [2, 4])
+    def test_matches_dense_attention(self, nsp):
+        args = RingAttnArgs(n_devices=nsp, batch=2, seq_local=16, head_dim=8)
+        bufs, specs, want = make_ring_buffers(args, seed=1)
+        plat = Platform.make_n_lanes(2, mesh=_mesh(nsp), specs=specs)
+        g = _graph(args)
+        ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+        order = get_all_sequences(g, plat, max_seqs=1)[0].sequence
+        out = ex.run(order)
+        np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
+
+    def test_every_schedule_is_equivalent(self):
+        """A handful of distinct schedules must all compute the same O."""
+        args = RingAttnArgs(n_devices=2, batch=1, seq_local=8, head_dim=8)
+        bufs, specs, want = make_ring_buffers(args, seed=2)
+        plat = Platform.make_n_lanes(2, mesh=_mesh(2), specs=specs)
+        seqs = get_all_sequences(_graph(args), plat, max_seqs=6)
+        assert len(seqs) >= 2
+        ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+        for s in seqs:
+            out = ex.run(s.sequence)
+            np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
+
+    def test_blocked_single_device_matches(self):
+        """BlockedAttention (no mesh): blockwise flash over resident K/V."""
+        from tenzing_tpu.models.ring_attention import (
+            BlockedAttention,
+            make_blocked_buffers,
+        )
+
+        args = RingAttnArgs(n_devices=4, batch=2, seq_local=8, head_dim=8)
+        from tenzing_tpu.solve.dfs import enumerate_schedules
+
+        bufs, want = make_blocked_buffers(args, seed=5)
+        plat = Platform.make_n_lanes(2)
+        g = Graph()
+        g.start_then(BlockedAttention(args, impl_choice=True))
+        g.then_finish(BlockedAttention(args, impl_choice=True))
+        # fair-share enumeration covers every kernel-menu variant (all-xla,
+        # all-pallas, and mixes)
+        seqs = enumerate_schedules(g, plat, max_seqs=64)
+        names = [";".join(op.name() for op in s.sequence) for s in seqs]
+        pallas = [s for s, n in zip(seqs, names) if ".pallas" in n]
+        xla = [s for s, n in zip(seqs, names) if ".pallas" not in n]
+        assert pallas and xla
+        ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+        for s in (pallas[0], xla[0]):
+            out = ex.run(s.sequence)
+            np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
+
+    def test_pallas_impl_matches(self):
+        """The Pallas kernel choice computes the same O (interpret mode)."""
+        args = RingAttnArgs(n_devices=2, batch=1, seq_local=8, head_dim=8)
+        bufs, specs, want = make_ring_buffers(args, seed=3)
+        plat = Platform.make_n_lanes(1, mesh=_mesh(2), specs=specs)
+        seqs = get_all_sequences(_graph(args, impl_choice=True), plat, max_seqs=60)
+        names = [";".join(op.name() for op in s.sequence) for s in seqs]
+        pallas = [s for s, n in zip(seqs, names) if ".pallas" in n]
+        assert pallas
+        ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+        out = ex.run(pallas[0].sequence)
+        np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
