@@ -93,6 +93,11 @@ class OpBase:
     def clone(self) -> "OpBase":
         return copy.copy(self)
 
+    def uses_pallas(self) -> bool:
+        """True when tracing this op emits a Pallas kernel (the executor relaxes
+        shard_map's varying-axes check only for such schedules)."""
+        return False
+
     def to_json(self) -> Dict[str, Any]:
         return {"kind": self.KIND, "name": self._name}
 
@@ -254,6 +259,9 @@ class BoundDeviceOp(BoundOp):
 
     def apply(self, bufs: Dict[str, Any], ctx: "TraceContext") -> Dict[str, Any]:
         return self._op.apply(bufs, ctx)
+
+    def uses_pallas(self) -> bool:
+        return self._op.uses_pallas()
 
     def to_json(self) -> Dict[str, Any]:
         j = self._op.to_json()

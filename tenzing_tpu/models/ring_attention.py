@@ -109,6 +109,9 @@ class AttnStepPallas(AttnStep):
 
         return attn_block_pallas(q, k, v, acc, m, l, self._args.scale)
 
+    def uses_pallas(self) -> bool:
+        return True
+
 
 class AttnStepChoice(ChoiceOp):
     """Implementation menu for one ring step: XLA einsums vs Pallas kernel."""
@@ -231,6 +234,9 @@ class BlockAttnStepPallas(BlockAttnStep):
     """Blocked step with the Pallas MXU kernel update."""
 
     _update = AttnStepPallas._update
+
+    def uses_pallas(self) -> bool:
+        return True
 
 
 class BlockAttnChoice(ChoiceOp):

@@ -255,6 +255,9 @@ class SpMVPallasOp(SpMVOp):
             return super().apply(bufs, ctx)
         return {self._y: ell_spmv_pallas(vals, cols, x)}
 
+    def uses_pallas(self) -> bool:
+        return True
+
 
 class SpMVImplChoice(ChoiceOp):
     """Implementation menu for one SpMV: XLA-gather vs Pallas vreg-gather

@@ -7,9 +7,9 @@ enqueued on ``cudaStream_t``, ordered by ``cudaEvent_t``
 happens-before structure is *traced into the HLO dependency graph* and XLA's
 latency-hiding scheduler executes under exactly those constraints:
 
-* each **lane** is a chain of ``optimization_barrier`` tokens: ops bound to the
-  same lane are serialized in sequence order, ops on different lanes share no
-  chain and may overlap (kernel/DMA/collective overlap is XLA's to exploit);
+* each **lane** is a chain of ordering tokens: ops bound to the same lane are
+  serialized in sequence order, ops on different lanes share no chain and may
+  overlap (async DMA / collective / host-transfer overlap is XLA's to exploit);
 * an **EventRecord** snapshots a lane's token; **WaitEvent** joins it into
   another lane's chain; **EventSync**/**LaneSync** join into the HOST chain —
   exact analogs of cudaEventRecord / cudaStreamWaitEvent / cudaEventSynchronize
@@ -22,10 +22,25 @@ latency-hiding scheduler executes under exactly those constraints:
   the graph's data edges (the reference achieves the same by the
   EventSynchronizer's construction, SURVEY.md §5).
 
+Token realization — WHY NOT ``optimization_barrier``: measured on real TPU
+hardware (v5e), the TPU backend *strips* ``opt-barrier`` during compilation
+(post-optimization HLO contains zero ``opt-barrier`` instructions), so
+barrier-chained schedules all lower to the same executable and timing is
+schedule-independent.  Tokens here are therefore **real data dependencies** the
+compiler cannot erase: a token is a finite float32 scalar derived from the
+producer's output, and ``tie(x, t)`` computes ``x + select(t != t, t, 0)`` — a
+value-preserving add (tokens are NaN-cleaned at creation so the select always
+yields 0 at runtime) that XLA cannot constant-fold because proving the select
+is zero would require value analysis it does not do.  Measured effect (64 MB
+host-offload + 16x4096^3 bf16 matmul chain, TPU v5e): fully-serialized schedule
+20.8 ms/iter (= sum of parts), 2-lane schedule 14.0 ms/iter (= overlap) — the
+schedule space is physically real on hardware under this encoding.
+
 Because each candidate schedule is its own compiled program, compile time is
 excluded from measurement (compile once, cache by schedule JSON) and the
-benchmarker fences with ``block_until_ready`` per measurement — SURVEY.md §7.2
-"Measurement fidelity".
+benchmarker fences with a device->host fetch per measurement (through a
+remote-tunnel PJRT backend, ``block_until_ready`` alone does not fence;
+see bench/benchmarker.py).
 """
 
 from __future__ import annotations
@@ -34,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from tenzing_tpu.core.operation import BoundDeviceOp, OpBase
 from tenzing_tpu.core.platform import Platform
@@ -42,21 +58,74 @@ from tenzing_tpu.core.sequence import Sequence
 from tenzing_tpu.core.serdes import sequence_to_json_str
 
 
-def _barrier(values):
-    return jax.lax.optimization_barrier(values)
+def _scalarize(leaf) -> Any:
+    """A float32 scalar data-dependent on ``leaf`` (its first element)."""
+    x = jnp.asarray(leaf).reshape(-1)[0]
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        x = jnp.real(x)
+    return x.astype(jnp.float32)
+
+
+def _clean(t):
+    """Scrub a token scalar to a finite value (select is opaque to constant
+    folding).  Inf must go too: joins sum tokens, and inf + (-inf) = NaN would
+    poison every downstream tie."""
+    return lax.select(jnp.isfinite(t), t, jnp.zeros((), t.dtype))
+
+
+def datatie(value, tok):
+    """``value`` unchanged, but consumers now also wait for ``tok``.
+
+    ``tok`` must be a cleaned (never-NaN) float32 scalar, so the select always
+    takes the zero branch at runtime; the compiler cannot prove that, so the
+    data edge survives TPU compilation (unlike ``optimization_barrier``).
+    """
+    z = lax.select(tok != tok, tok, jnp.zeros((), tok.dtype))
+    if jnp.issubdtype(jnp.asarray(value).dtype, jnp.bool_):
+        return jnp.logical_or(value, z != 0.0)
+    return value + z.astype(jnp.asarray(value).dtype)
 
 
 class TraceContext:
     """Mutable tracing state threaded through one schedule trace: the buffer
-    dict (SSA), one token per lane, the host token, and one token per event."""
+    dict (SSA), one token per lane, the host token, and one token per event.
 
-    def __init__(self, bufs: Dict[str, Any], axis_names=()):
+    ``tokens`` (optional) seeds the chains — the benchmark loop carries token
+    state across samples so a serialized schedule stays serialized from one
+    sample to the next (the reference's cudaStream chains likewise persist
+    across the hot loop's samples, benchmarker.cpp:93-99)."""
+
+    def __init__(
+        self,
+        bufs: Dict[str, Any],
+        axis_names=(),
+        tokens: Optional[Dict[str, Any]] = None,
+        host_space: Optional[set] = None,
+    ):
         self.bufs = bufs
         self.axis_names = tuple(axis_names)
+        # names of buffers resident in host memory: the TPU toolchain only
+        # supports pure copies on host-space tensors (no arithmetic/slicing —
+        # measured: host-side add/reshape/slice fail to compile), so ties,
+        # awaits and fences must skip them
+        self.host_space: set = set(host_space) if host_space else set()
         self._zero = jnp.zeros((), jnp.float32)
-        self._lane_tok: Dict[int, Any] = {}
-        self._ev_tok: Dict[int, Any] = {}
-        self._host_tok = self._zero
+        if tokens is None:
+            self._lane_tok: Dict[int, Any] = {}
+            self._ev_tok: Dict[int, Any] = {}
+            self._host_tok = self._zero
+        else:
+            self._lane_tok = dict(tokens["lanes"])
+            self._ev_tok = dict(tokens["events"])
+            self._host_tok = tokens["host"]
+
+    def token_state(self) -> Dict[str, Any]:
+        """The chains' current tips, in a fori_loop-carryable pytree."""
+        return {
+            "host": self._host_tok,
+            "lanes": dict(self._lane_tok),
+            "events": dict(self._ev_tok),
+        }
 
     # -- token plumbing ----------------------------------------------------
     def _lane(self, lane: Lane):
@@ -64,13 +133,23 @@ class TraceContext:
 
     def _join(self, *toks):
         toks = [t for t in toks if t is not None]
-        if len(toks) == 1:
-            return toks[0]
-        return _barrier(tuple(toks))[0]
+        if not toks:
+            return self._zero
+        out = toks[0]
+        for t in toks[1:]:
+            out = out + t
+        return out
 
     def _tie(self, value, tok):
         """Value unchanged, but consumers now also wait for ``tok``."""
-        return _barrier((value, tok))[0]
+        return datatie(value, tok)
+
+    def tie_named(self, name: str, value, tok):
+        """Tie, unless ``name`` is host-resident (host-space tensors admit no
+        arithmetic; ordering then rests on data dependencies alone)."""
+        if name in self.host_space:
+            return value
+        return datatie(value, tok)
 
     # -- op tracing --------------------------------------------------------
     def trace_default(self, op) -> None:
@@ -86,7 +165,7 @@ class TraceContext:
         if reads:
             view = dict(self.bufs)
             for name in reads:
-                view[name] = self._tie(view[name], tok_in)
+                view[name] = self.tie_named(name, view[name], tok_in)
         out = op.apply(view, self)
         for name, val in out.items():
             if name not in self.bufs:
@@ -95,8 +174,13 @@ class TraceContext:
                     "it in the executor's initial buffers"
                 )
             self.bufs[name] = val
-        leaves = jax.tree_util.tree_leaves(out)
-        tok_out = _barrier(tuple([tok_in] + leaves))[0] if leaves else tok_in
+        leaves = [
+            l
+            for name, val in out.items()
+            if name not in self.host_space
+            for l in jax.tree_util.tree_leaves(val)
+        ]
+        tok_out = self._join(tok_in, *[_clean(_scalarize(l)) for l in leaves])
         if is_device:
             self._lane_tok[op.lane().id] = tok_out
         else:
@@ -136,11 +220,55 @@ class TraceExecutor:
         self._cache: Dict[str, Callable] = {}
 
     # -- build -------------------------------------------------------------
+    def _initial_host_space(self) -> set:
+        """Buffer names whose initial arrays live in host memory."""
+        names = set()
+        for k, v in self.init_bufs.items():
+            mk = getattr(getattr(v, "sharding", None), "memory_kind", None)
+            if mk is not None and "host" in str(mk):
+                names.add(k)
+        return names
+
+    def _host_space_after(self, ops: List[OpBase]) -> set:
+        """Host-space buffer names once the schedule has traced (transfer ops
+        move names between spaces deterministically via DST_SPACE)."""
+        names = self._initial_host_space()
+        for op in ops:
+            dst_space = getattr(op, "DST_SPACE", None)
+            if dst_space is not None:
+                for w in op.writes():
+                    if dst_space == "host":
+                        names.add(w)
+                    else:
+                        names.discard(w)
+        return names
+
     def _traced(self, ops: List[OpBase], bufs: Dict[str, Any]) -> Dict[str, Any]:
-        tc = TraceContext(dict(bufs), axis_names=self.platform.axis_names)
+        tc = TraceContext(
+            dict(bufs),
+            axis_names=self.platform.axis_names,
+            host_space=self._initial_host_space(),
+        )
         for op in ops:
             op.trace(tc)
         return tc.bufs
+
+    @staticmethod
+    def _token_template(ops: List[OpBase]) -> Dict[str, Any]:
+        """Zero-token state covering every lane/event the schedule can touch —
+        a stable carry structure for the benchmark loop."""
+        zero = jnp.zeros((), jnp.float32)
+        lanes: Dict[int, Any] = {}
+        events: Dict[int, Any] = {}
+        for op in ops:
+            for l in getattr(op, "lanes", lambda: [])():
+                lanes[l.id] = zero
+            for e in getattr(op, "events", lambda: [])():
+                events[e.id] = zero
+        return {"host": zero, "lanes": lanes, "events": events}
+
+    def _has_pallas(self, ops: List[OpBase]) -> bool:
+        return any(getattr(op, "uses_pallas", lambda: False)() for op in ops)
 
     def _build(self, order: Sequence) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
         """The (unjitted) program for a schedule: trace, then shard_map over the
@@ -153,11 +281,13 @@ class TraceExecutor:
         mesh = self.platform.mesh
         if mesh is not None:
             specs = {name: self.platform.spec(name) for name in self.init_bufs}
-            # check_vma=False: the Pallas interpreter's internal slicing fails
-            # jax's varying-axes check under shard_map (upstream limitation);
-            # data deps are already guaranteed by the SSA buffer dict
+            # check_vma=False only when a Pallas kernel is in the schedule: the
+            # Pallas interpreter's internal slicing fails jax's varying-axes
+            # check under shard_map (upstream limitation).  Plain-XLA schedules
+            # keep the safety check on (ADVICE r1).
+            kw = {"check_vma": False} if self._has_pallas(ops) else {}
             fn = jax.shard_map(
-                fn, mesh=mesh, in_specs=(specs,), out_specs=specs, check_vma=False
+                fn, mesh=mesh, in_specs=(specs,), out_specs=specs, **kw
             )
         return fn
 
@@ -185,6 +315,86 @@ class TraceExecutor:
 
         return run_once
 
+    def prepare_n(self, order: Sequence) -> Callable[[int], None]:
+        """Repeat-``n``-inside-one-program runner — the benchmark hot loop.
+
+        The reference times ``for sample in 0..n: for op in order: op->run()``
+        between two fences (benchmarker.cpp:83-119).  Here the sample loop is a
+        ``fori_loop`` *inside* the compiled program carrying the buffer dict
+        (ops re-run on their own outputs, exactly like the reference re-running
+        ops on the same device buffers), and the fence is a ``device_get`` of
+        one scalar reduced from every output buffer: through a remote-tunnel
+        PJRT backend ``block_until_ready`` returns before execution finishes
+        (measured: timing flat in n), so only a device->host fetch fences; the
+        full-reduction fence also makes every op's output live (no dead-code
+        narrowing of the final ops) and costs one pass *after* the loop,
+        amortized over all n samples."""
+        ops = order.vector()
+        key = "n:" + sequence_to_json_str(order)
+        if key in self._cache:
+            f = self._cache[key]
+        else:
+            axis_names = self.platform.axis_names
+            tok0 = self._token_template(ops)
+            host_space0 = self._initial_host_space()
+            host_space_final = self._host_space_after(ops)
+
+            def body(state):
+                bufs, toks = state
+                tc = TraceContext(
+                    dict(bufs), axis_names=axis_names, tokens=toks, host_space=host_space0
+                )
+                for op in ops:
+                    op.trace(tc)
+                return (tc.bufs, tc.token_state())
+
+            mesh = self.platform.mesh
+            if mesh is not None:
+                specs = {name: self.platform.spec(name) for name in self.init_bufs}
+                from jax.sharding import PartitionSpec
+
+                tok_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(), tok0)
+                kw = {"check_vma": False} if self._has_pallas(ops) else {}
+                body = jax.shard_map(
+                    body,
+                    mesh=mesh,
+                    in_specs=((specs, tok_specs),),
+                    out_specs=(specs, tok_specs),
+                    **kw,
+                )
+
+            def stepped(bufs: Dict[str, Any], n) -> Any:
+                out, _ = lax.fori_loop(0, n, lambda i, s: body(s), (bufs, tok0))
+                fence = jnp.zeros((), jnp.float32)
+                host_outs = {}
+                for name, val in out.items():
+                    if name in host_space_final:
+                        # host-space tensors admit no arithmetic; returning
+                        # them as program outputs keeps a trailing un-fetched
+                        # spill alive (only the fence scalar is device_get)
+                        host_outs[name] = val
+                        continue
+                    for leaf in jax.tree_util.tree_leaves(val):
+                        x = jnp.asarray(leaf)
+                        if jnp.issubdtype(x.dtype, jnp.complexfloating):
+                            x = jnp.real(x)
+                        fence = fence + jnp.sum(x).astype(jnp.float32)
+                return fence, host_outs
+
+            f = jax.jit(stepped)
+            self._cache[key] = f
+        bufs = self.init_bufs
+
+        def run_n(n: int) -> None:
+            jax.device_get(f(bufs, jnp.int32(n))[0])
+
+        return run_n
+
     def lowered_text(self, order: Sequence) -> str:
-        """Lowered HLO of a schedule (debugging / tests)."""
+        """Lowered (pre-optimization) HLO of a schedule (debugging / tests)."""
         return jax.jit(self._build(order)).lower(self.init_bufs).as_text()
+
+    def compiled_text(self, order: Sequence) -> str:
+        """Post-optimization HLO — what actually runs; the token data edges
+        must still be visible here (the whole point of ``datatie``)."""
+        return jax.jit(self._build(order)).lower(self.init_bufs).compile().as_text()
