@@ -1,0 +1,215 @@
+"""Single-chip 3D halo-exchange pipeline: the north-star benchmark workload.
+
+Parity target: the reference's halo-exchange benchmark graph
+(``HaloExchange::add_to_graph``, src/halo_exchange/ops_halo_exchange.cu:33-257)
+— per face direction ``Pack(GpuOp) -> OwningIsend -> MultiWait`` and
+``OwningIrecv -> Wait -> Unpack(GpuOp)``, searched over order x stream
+assignment with config nQ=3, 512^3 cells, radius 3
+(halo_run_strategy.hpp:42-49; BASELINE.md).
+
+TPU-native single-chip realization.  The environment benches on ONE chip, so
+the network hop is realized as the chip's asynchronous host round-trip DMA
+(``HostSpillStart`` -> ``HostFetchStart``, the measured overlap substrate of
+experiments/lane_overlap.py) — each direction's face travels
+device -> pinned-host -> device, the single-chip analog of the reference's
+staging through MPI.  Numerically this is the periodic 1x1x1-shard case: every
+ghost shell receives the shard's own opposite interior face (the same result
+``models/halo.py`` computes on an ``mx=my=mz=1`` mesh).
+
+Per direction ``d`` the DAG is::
+
+    pack_d (DeviceOp, lane-searched)      # slice interior face -> buf_d
+      -> spill_d (HostSpillStart)         # post async device->host DMA
+      -> fetch_d (HostFetchStart)         # post async host->device DMA
+      -> await_d (AwaitTransfer)          # the reference's Wait
+      -> unpack_d (DeviceOp, lane-searched)  # write ghost shell
+
+The six chains are independent: the searched freedom is exactly the
+reference's — how the six posts, waits, packs and unpacks interleave across
+lanes, with the naive baseline (``naive_order``) the fully-synchronous
+serialization that finishes each direction before starting the next (post
+immediately awaited: MPI_Send-like blocking semantics).
+
+Send-side completion note: the reference wires every ``OwningIsend`` into one
+``MultiWait("he_wait_sends")`` because MPI requests must be waited.  Here the
+spill's completion handle is the host buffer itself, which the fetch consumes
+as a data dependency, so a separate send-side wait op would be a no-op by
+construction (comm_ops.AwaitTransfer skips host-space buffers); the
+post/await split on the receive side carries the whole overlap freedom.
+
+With ``impl_choice=True`` pack/unpack become ChoiceOps over an XLA-slice vs
+Pallas-kernel menu (ops/halo_pallas.py) — the analog of the reference's two
+storage-order CUDA kernel families (ops_halo_exchange.cu:519-699).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import Finish, Start
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.models.halo import (
+    DIRECTIONS,
+    HaloArgs,
+    Pack,
+    Unpack,
+    _face_slices,
+    dir_name,
+)
+from tenzing_tpu.ops.comm_ops import AwaitTransfer, HostFetchStart, HostSpillStart
+
+
+def _flat_rows(sizes) -> int:
+    """Rows of the (rows, 128) staging layout for a face of ``sizes``."""
+    n = int(np.prod(sizes))
+    return -(-n // 128)
+
+
+class PackFlat(Pack):
+    """Pack that emits the face as a 128-lane-flattened (rows, 128) staging
+    buffer.  Probed on both the CPU backend and TPU v5e: spilling a 4D face
+    with a tiny trailing dim (z-faces are (nq, lx, ly, r)) through
+    pinned-host memory corrupts the round-trip (XLA copies only a partial
+    stripe — a layout bug in mixed-memory copies of oddly-shaped tensors), so
+    every staged transfer uses the 2D tiled layout the host-offload path is
+    reliable for — which is also what the reference does with its staging
+    buffers (contiguous pack buffers, ops_halo_exchange.hpp:97-186)."""
+
+    def apply(self, bufs, ctx):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        starts, sizes = _face_slices(self._args, self._d, "pack")
+        sl = lax.dynamic_slice(bufs["U"], starts, sizes)
+        n = int(np.prod(sizes))
+        flat = jnp.pad(sl.reshape(-1), (0, _flat_rows(sizes) * 128 - n))
+        return {f"buf_{dir_name(self._d)}": flat.reshape(-1, 128)}
+
+
+class UnpackRecv(Unpack):
+    """Unpack reading the fetched (round-tripped) flat staging buffer: reshape
+    back to the face extents, then the same ghost-shell write as
+    models/halo.Unpack."""
+
+    def reads(self):
+        return ["U", f"recv_{dir_name(self._d)}"]
+
+    def apply(self, bufs, ctx):
+        import jax.lax as lax
+
+        starts, _ = _face_slices(self._args, self._d, "unpack")
+        _, sizes = _face_slices(self._args, self._d, "pack")
+        n = int(np.prod(sizes))
+        face = bufs[f"recv_{dir_name(self._d)}"].reshape(-1)[:n].reshape(tuple(sizes))
+        return {"U": lax.dynamic_update_slice(bufs["U"], face, starts)}
+
+
+def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = False):
+    """The 5-op chain for one face direction."""
+    name = dir_name(d)
+    if impl_choice:
+        from tenzing_tpu.ops.halo_pallas import PackChoice, UnpackChoice
+
+        pack = PackChoice(args, d)
+        unpack = UnpackChoice(args, d)
+    else:
+        pack = PackFlat(args, d)
+        unpack = UnpackRecv(args, d)
+    spill = HostSpillStart(f"spill_{name}", f"buf_{name}", f"host_{name}")
+    fetch = HostFetchStart(f"fetch_{name}", f"host_{name}", f"recv_{name}")
+    await_ = AwaitTransfer(f"await_{name}", f"recv_{name}")
+    return pack, spill, fetch, await_, unpack
+
+
+def add_to_graph(
+    g: Graph,
+    args: HaloArgs,
+    preds: Optional[List] = None,
+    succs: Optional[List] = None,
+    impl_choice: bool = False,
+) -> Graph:
+    """Six independent pack -> spill -> fetch -> await -> unpack chains
+    (reference HaloExchange::add_to_graph shape, ops_halo_exchange.cu:33-257)."""
+    preds = preds if preds is not None else [g.start()]
+    succs = succs if succs is not None else [g.finish()]
+    for d in DIRECTIONS:
+        pack, spill, fetch, await_, unpack = direction_ops(args, d, impl_choice)
+        for p in preds:
+            g.then(p, pack)
+        g.then(pack, spill)
+        g.then(spill, fetch)
+        g.then(fetch, await_)
+        g.then(await_, unpack)
+        for s in succs:
+            g.then(unpack, s)
+    return g
+
+
+def build_graph(args: HaloArgs, impl_choice: bool = False) -> Graph:
+    return add_to_graph(Graph(), args, impl_choice=impl_choice)
+
+
+def naive_order(args: HaloArgs, platform) -> Sequence:
+    """The naive sequential baseline: one lane, each direction's chain completed
+    (post immediately awaited) before the next starts — the fully-synchronous
+    program the search must beat (BASELINE.md north star)."""
+    lane = platform.lanes[0]
+    ops: List = [Start()]
+    for d in DIRECTIONS:
+        pack, spill, fetch, await_, unpack = direction_ops(args, d)
+        ops += [pack.bind(lane), spill, fetch, await_, unpack.bind(lane)]
+    ops.append(Finish())
+    return Sequence(ops)
+
+
+def _padded_shape(shape: Tuple[int, int, int, int]) -> Tuple[int, int, int, int]:
+    """U allocated with trailing dims padded to TPU tiling (8 sublanes x 128
+    lanes): Mosaic requires HBM plane DMAs tile-aligned (ops/halo_pallas.py),
+    and the padding is invisible to the XLA slice path (all face slices are
+    interior)."""
+    nq, x, y, z = shape
+    return (nq, x, -(-y // 8) * 8, -(-z // 128) * 128)
+
+
+def make_pipeline_buffers(
+    args: HaloArgs, seed: int = 0, dtype=np.float32, with_expected: bool = True
+) -> Tuple[Dict[str, np.ndarray], Optional[np.ndarray]]:
+    """(buffers, expected U): ghost shells filled with the shard's own opposite
+    interior faces (periodic 1-shard domain).  ``with_expected=False`` skips
+    the expected-U copy (a ~2 GB allocation at the reference bench config)."""
+    r = args.radius
+    rng = np.random.default_rng(seed)
+    U = np.zeros(_padded_shape(args.local_shape()), dtype=dtype)
+    U[:, r : r + args.lx, r : r + args.ly, r : r + args.lz] = rng.random(
+        (args.nq, args.lx, args.ly, args.lz), dtype=np.float32
+    ).astype(dtype)
+    want = None
+    if with_expected:
+        want = U.copy()
+        for d in DIRECTIONS:
+            ps, sz = _face_slices(args, d, "pack")
+            us, _ = _face_slices(args, d, "unpack")
+            face = U[
+                :, ps[1] : ps[1] + sz[1], ps[2] : ps[2] + sz[2], ps[3] : ps[3] + sz[3]
+            ]
+            want[
+                :, us[1] : us[1] + sz[1], us[2] : us[2] + sz[2], us[3] : us[3] + sz[3]
+            ] = face
+    bufs: Dict[str, np.ndarray] = {"U": U}
+    for d in DIRECTIONS:
+        name = dir_name(d)
+        _, sz = _face_slices(args, d, "pack")
+        flat = np.zeros((_flat_rows(sz), 128), dtype=dtype)
+        bufs[f"buf_{name}"] = flat
+        bufs[f"host_{name}"] = flat.copy()  # placed in pinned_host by the caller
+        bufs[f"recv_{name}"] = flat.copy()
+    return bufs, want
+
+
+def host_buffer_names() -> List[str]:
+    """Buffers that must be device_put into pinned_host before execution (the
+    executor detects host residency from the array's sharding memory_kind)."""
+    return [f"host_{dir_name(d)}" for d in DIRECTIONS]

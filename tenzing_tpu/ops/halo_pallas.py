@@ -1,0 +1,194 @@
+"""Pallas pack/unpack kernels for the halo faces — the kernel menu.
+
+Parity target: the reference ships TWO CUDA kernel families for halo
+pack/unpack, selected by storage order (``pack_kernel_qxyz`` warp-per-gridpoint
+vs ``pack_kernel_xyzq`` thread-per-gridpoint, ops_halo_exchange.cu:519-573 and
+the mirror unpack kernels :611-699, launch-config selection in Pack::run /
+Unpack::run) — a per-workload implementation choice the search explores.
+
+TPU-native menu: the XLA path (``models/halo.Pack``/``Unpack``) lowers the face
+slice to XLA's fusion machinery; this module is the alternative — an explicit
+**plane-DMA kernel**: per (q, face-row) grid step the full (Y, Z) plane is
+DMA'd between HBM and VMEM with ``pltpu.make_async_copy`` and the unaligned
+face window is extracted (pack) or merged (unpack read-modify-write) in
+registers.  Mosaic requires HBM DMA slices to be 128-lane aligned (probed on
+v5e: "Slice shape along dimension 3 must be aligned to tiling (128)"), so the
+ragged face cut lives in VMEM — trading extra plane bandwidth for aligned DMA,
+vs the XLA path's fused narrow copy.  Which wins per face shape (x-faces are
+lane-contiguous, z-faces are 3-element strided in the lane dim) is exactly the
+storage-order question the reference's two kernel families answer — so it is
+exposed as a ChoiceOp and searched (SpMV's kernel menu precedent,
+models/spmv.py SpMVImplChoice).
+
+Off-TPU the kernels run in the Pallas interpreter (``interpret=True``), same
+code path as the repo's other Pallas kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import numpy as np
+
+from tenzing_tpu.core.operation import ChoiceOp, OpBase
+from tenzing_tpu.models.halo import HaloArgs, _face_slices, dir_name
+from tenzing_tpu.models.halo_pipeline import PackFlat, UnpackRecv, _flat_rows
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(
+    jax.jit, static_argnames=("starts", "sizes", "interpret")
+)
+def pack_face_pallas(
+    u: jax.Array, starts: Tuple[int, ...], sizes: Tuple[int, ...], interpret: bool = False
+) -> jax.Array:
+    """out[q, i, :, :] = u[q, x0+i, y0:y0+sy, z0:z0+sz]: full-plane DMA in,
+    ragged face window extracted in VMEM."""
+    nq, sx, sy, sz = sizes
+    _, x0, y0, z0 = starts
+    _, _, Y, Z = u.shape
+
+    def kernel(u_ref, o_ref, plane, sem):
+        q = pl.program_id(0)
+        i = pl.program_id(1)
+        cp = pltpu.make_async_copy(u_ref.at[q, x0 + i], plane, sem)
+        cp.start()
+        cp.wait()
+        o_ref[0, 0] = plane[y0 : y0 + sy, z0 : z0 + sz]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nq, sx),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, 1, sy, sz), lambda q, i: (q, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nq, sx, sy, sz), u.dtype),
+        scratch_shapes=[pltpu.VMEM((Y, Z), u.dtype), pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )(u)
+
+
+@functools.partial(jax.jit, static_argnames=("starts", "interpret"))
+def unpack_face_pallas(
+    u: jax.Array, face: jax.Array, starts: Tuple[int, ...], interpret: bool = False
+) -> jax.Array:
+    """u[q, x0+i, y0:y0+sy, z0:z0+sz] = face[q, i, :, :], in place (aliased):
+    read-modify-write of each touched plane through VMEM."""
+    nq, sx, sy, sz = face.shape
+    _, x0, y0, z0 = starts
+    _, _, Y, Z = u.shape
+
+    def kernel(u_ref, f_ref, o_ref, plane, sem):
+        q = pl.program_id(0)
+        i = pl.program_id(1)
+        cp_in = pltpu.make_async_copy(u_ref.at[q, x0 + i], plane, sem)
+        cp_in.start()
+        cp_in.wait()
+        plane[y0 : y0 + sy, z0 : z0 + sz] = f_ref[0, 0]
+        cp_out = pltpu.make_async_copy(plane, o_ref.at[q, x0 + i], sem)
+        cp_out.start()
+        cp_out.wait()
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nq, sx),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1, sy, sz), lambda q, i: (q, i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        scratch_shapes=[pltpu.VMEM((Y, Z), u.dtype), pltpu.SemaphoreType.DMA],
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(u, face)
+
+
+# -- ops + choice menu ------------------------------------------------------------
+
+
+class PackPallas(PackFlat):
+    """Pack via the plane-DMA kernel, then flatten to the (rows, 128) staging
+    layout (menu alternative to the XLA slice)."""
+
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(args, d)
+        self._name = f"pack_{dir_name(d)}.pallas"
+
+    def apply(self, bufs, ctx):
+        starts, sizes = _face_slices(self._args, self._d, "pack")
+        out = pack_face_pallas(
+            bufs["U"], tuple(starts), tuple(sizes), interpret=_interpret()
+        )
+        n = int(np.prod(sizes))
+        flat = jnp.pad(out.reshape(-1), (0, _flat_rows(sizes) * 128 - n))
+        return {f"buf_{dir_name(self._d)}": flat.reshape(-1, 128)}
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
+class PackXla(PackFlat):
+    """The XLA-slice pack under a menu-distinct name."""
+
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(args, d)
+        self._name = f"pack_{dir_name(d)}.xla"
+
+
+class UnpackPallas(UnpackRecv):
+    """Unpack via the aliased plane-DMA kernel."""
+
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(args, d)
+        self._name = f"unpack_{dir_name(d)}.pallas"
+
+    def apply(self, bufs, ctx):
+        starts, _ = _face_slices(self._args, self._d, "unpack")
+        _, sizes = _face_slices(self._args, self._d, "pack")
+        n = int(np.prod(sizes))
+        face = (
+            bufs[f"recv_{dir_name(self._d)}"].reshape(-1)[:n].reshape(tuple(sizes))
+        )
+        out = unpack_face_pallas(
+            bufs["U"], face, tuple(starts), interpret=_interpret()
+        )
+        return {"U": out}
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
+class UnpackXla(UnpackRecv):
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(args, d)
+        self._name = f"unpack_{dir_name(d)}.xla"
+
+
+class PackChoice(ChoiceOp):
+    """XLA slice vs Pallas DMA kernel for one direction's pack (the reference's
+    storage-order kernel-family selection as a searched ChoiceOp)."""
+
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(f"pack_{dir_name(d)}")
+        self._args, self._d = args, tuple(d)
+
+    def choices(self) -> List[OpBase]:
+        return [PackXla(self._args, self._d), PackPallas(self._args, self._d)]
+
+
+class UnpackChoice(ChoiceOp):
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(f"unpack_{dir_name(d)}")
+        self._args, self._d = args, tuple(d)
+
+    def choices(self) -> List[OpBase]:
+        return [UnpackXla(self._args, self._d), UnpackPallas(self._args, self._d)]

@@ -1,0 +1,181 @@
+"""Single-chip halo pipeline: post/wait split, numerics, overlap orderings,
+and the Pallas pack/unpack kernel menu."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.models.halo import DIRECTIONS, HaloArgs, _face_slices, dir_name
+from tenzing_tpu.models.halo_pipeline import (
+    build_graph,
+    host_buffer_names,
+    make_pipeline_buffers,
+    naive_order,
+)
+from tenzing_tpu.runtime.executor import TraceExecutor
+from tenzing_tpu.solve.dfs import get_all_sequences
+
+ARGS = HaloArgs(nq=2, lx=4, ly=4, lz=4, radius=1)
+
+
+def _executor(args=ARGS, n_lanes=2):
+    bufs, want = make_pipeline_buffers(args, seed=0)
+    host_sh = jax.sharding.SingleDeviceSharding(
+        jax.devices()[0], memory_kind="pinned_host"
+    )
+    jbufs = {}
+    for k, v in bufs.items():
+        if k in host_buffer_names():
+            jbufs[k] = jax.device_put(jnp.asarray(v), host_sh)
+        else:
+            jbufs[k] = jnp.asarray(v)
+    return TraceExecutor(Platform.make_n_lanes(n_lanes), jbufs), want
+
+
+def test_naive_order_numerics():
+    ex, want = _executor(n_lanes=1)
+    out = ex.run(naive_order(ARGS, ex.platform))
+    np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
+
+
+def test_searched_schedules_same_answer():
+    """Any legal order x lane assignment computes the periodic ghost fill."""
+    ex, want = _executor()
+    g = build_graph(ARGS)
+    states = get_all_sequences(g, ex.platform, max_seqs=4)
+    assert states
+    for st in states:
+        out = ex.run(st.sequence)
+        np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
+
+
+def test_overlap_orderings_exist():
+    """The enumerated space must contain schedules with work between a fetch
+    post and its await — the overlap freedom the post/wait split exists for
+    (VERDICT r1 item 3 exit test)."""
+    g = build_graph(ARGS)
+    plat = Platform.make_n_lanes(1)
+    found = False
+    for st in get_all_sequences(g, plat, max_seqs=200):
+        names = [op.name() for op in st.sequence.vector()]
+        for d in DIRECTIONS:
+            nd = dir_name(d)
+            i = names.index(f"fetch_{nd}")
+            j = names.index(f"await_{nd}")
+            between = [
+                n
+                for n in names[i + 1 : j]
+                if not n.startswith(("spill", "fetch", "await"))
+            ]
+            if between:
+                found = True
+                break
+        if found:
+            break
+    assert found, "no enumerated schedule overlaps compute with an in-flight fetch"
+
+
+def test_naive_is_fully_synchronous():
+    """The baseline awaits every transfer immediately: no op between fetch and
+    await, directions strictly sequential."""
+    order = naive_order(ARGS, Platform.make_n_lanes(1))
+    names = [op.name() for op in order.vector()]
+    for d in DIRECTIONS:
+        nd = dir_name(d)
+        assert names.index(f"await_{nd}") == names.index(f"fetch_{nd}") + 1
+
+
+def test_pallas_pack_matches_xla_slice():
+    from tenzing_tpu.ops.halo_pallas import pack_face_pallas
+
+    rng = np.random.default_rng(3)
+    u = jnp.asarray(rng.random((2, 6, 6, 6), dtype=np.float32))
+    for d in DIRECTIONS:
+        starts, sizes = _face_slices(ARGS, d, "pack")
+        got = pack_face_pallas(u, tuple(starts), tuple(sizes), interpret=True)
+        want = jax.lax.dynamic_slice(u, starts, sizes)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_unpack_matches_xla_update():
+    from tenzing_tpu.ops.halo_pallas import unpack_face_pallas
+
+    rng = np.random.default_rng(4)
+    u = jnp.asarray(rng.random((2, 6, 6, 6), dtype=np.float32))
+    for d in DIRECTIONS:
+        starts, sizes = _face_slices(ARGS, d, "unpack")
+        face = jnp.asarray(rng.random(tuple(sizes), dtype=np.float32))
+        got = unpack_face_pallas(u, face, tuple(starts), interpret=True)
+        want = jax.lax.dynamic_update_slice(u, face, starts)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_impl_choice_graph_enumerates_kernel_menu():
+    """With impl_choice=True the solver sees ChooseOp decisions for pack/unpack
+    and every resolved schedule still computes the right answer."""
+    ex, want = _executor()
+    g = build_graph(ARGS, impl_choice=True)
+    states = get_all_sequences(g, ex.platform, max_seqs=40)
+    assert states
+    seen_pallas = False
+    for st in states:
+        names = [op.name() for op in st.sequence.vector()]
+        seen_pallas = seen_pallas or any(n.endswith(".pallas") for n in names)
+    assert seen_pallas, "kernel menu never resolved to a Pallas variant"
+    for st in states[:2]:
+        out = ex.run(st.sequence)
+        np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
+
+
+def test_single_device_numerics_subprocess():
+    """Regression: on a SINGLE device (no xla_force_host_platform_device_count,
+    the configuration the real TPU bench runs in), spilling 4D faces with tiny
+    trailing dims through pinned_host corrupted the round-trip (partial-stripe
+    copies; reproduced on CPU and TPU v5e).  The (rows, 128) staging layout
+    must survive — this runs where conftest's 8-device env cannot mask it."""
+    import subprocess
+    import sys as _sys
+
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+import jax, jax.numpy as jnp, numpy as np
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.models.halo import HaloArgs
+from tenzing_tpu.models.halo_pipeline import (
+    host_buffer_names, make_pipeline_buffers, naive_order)
+from tenzing_tpu.runtime.executor import TraceExecutor
+args = HaloArgs(nq=2, lx=4, ly=4, lz=4, radius=1)
+bufs, want = make_pipeline_buffers(args, seed=0)
+host_sh = jax.sharding.SingleDeviceSharding(jax.devices()[0], memory_kind="pinned_host")
+jbufs = {k: (jax.device_put(jnp.asarray(v), host_sh) if k in host_buffer_names()
+             else jnp.asarray(v)) for k, v in bufs.items()}
+plat = Platform.make_n_lanes(1)
+U = np.asarray(TraceExecutor(plat, jbufs).run(naive_order(args, plat))["U"])
+assert (U == want).all(), f"{(U != want).sum()} corrupted elements"
+print("SINGLE_DEVICE_OK")
+"""
+    out = subprocess.run(
+        [_sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd="/root/repo",
+        env={k: v for k, v in __import__("os").environ.items() if k != "XLA_FLAGS"},
+    )
+    assert "SINGLE_DEVICE_OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_pipeline_benchmarkable_smoke():
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+
+    ex, _ = _executor(n_lanes=1)
+    bench = EmpiricalBenchmarker(ex)
+    res = bench.benchmark(
+        naive_order(ARGS, ex.platform), BenchOpts(n_iters=3, target_secs=0.0005)
+    )
+    assert res.pct50 > 0.0
