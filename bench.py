@@ -99,10 +99,13 @@ def build_spmv(args):
     bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, seed=0)
     bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
     # impl_choice: the kernel menu (XLA gather vs Pallas vreg-gather) is part
-    # of the searched space alongside order and lane assignment
+    # of the searched space alongside order and lane assignment; known x sizes
+    # prune Pallas choices that would only alias the XLA path (ADVICE r1)
+    x_sizes = {"x_local": int(bufs["x_local"].shape[0]),
+               "x_remote": int(bufs["x_remote"].shape[0])}
     g = Graph()
-    g.start_then(SpMVCompound(impl_choice=True))
-    g.then_finish(SpMVCompound(impl_choice=True))
+    g.start_then(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
+    g.then_finish(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
     return g, bufs, f"spmv_iter_pct50_searched_m{m}"
 
 
@@ -148,10 +151,12 @@ def main() -> int:
 
     # must match the metric the build_* functions return for the same config
     halo_n = 4 if args.smoke else args.halo_n
+    spmv_m = args.m if args.m is not None else (512 if args.smoke else 150_000)
+    attn_n = 4 * 16 if args.smoke else 8 * 1024
     metric_name = {
         "halo": f"halo_iter_pct50_searched_n{halo_n}",
-        "spmv": "spmv_iter_pct50_searched",
-        "attn": "attn_blockwise_pct50_searched",
+        "spmv": f"spmv_iter_pct50_searched_m{spmv_m}",
+        "attn": f"attn_blockwise_pct50_searched_n{attn_n}",
     }[args.workload]
     try:
         devs = probe_backend()

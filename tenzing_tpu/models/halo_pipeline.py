@@ -68,6 +68,22 @@ def _flat_rows(sizes) -> int:
     return -(-n // 128)
 
 
+def flatten_face(face, sizes):
+    """Face tensor -> (rows, 128) staging layout (shared by the XLA and Pallas
+    pack variants; the inverse of :func:`unflatten_face`)."""
+    import jax.numpy as jnp
+
+    n = int(np.prod(sizes))
+    flat = jnp.pad(face.reshape(-1), (0, _flat_rows(sizes) * 128 - n))
+    return flat.reshape(-1, 128)
+
+
+def unflatten_face(flat, sizes):
+    """(rows, 128) staging layout -> face tensor of ``sizes``."""
+    n = int(np.prod(sizes))
+    return flat.reshape(-1)[:n].reshape(tuple(sizes))
+
+
 class PackFlat(Pack):
     """Pack that emits the face as a 128-lane-flattened (rows, 128) staging
     buffer.  Probed on both the CPU backend and TPU v5e: spilling a 4D face
@@ -80,13 +96,10 @@ class PackFlat(Pack):
 
     def apply(self, bufs, ctx):
         import jax.lax as lax
-        import jax.numpy as jnp
 
         starts, sizes = _face_slices(self._args, self._d, "pack")
         sl = lax.dynamic_slice(bufs["U"], starts, sizes)
-        n = int(np.prod(sizes))
-        flat = jnp.pad(sl.reshape(-1), (0, _flat_rows(sizes) * 128 - n))
-        return {f"buf_{dir_name(self._d)}": flat.reshape(-1, 128)}
+        return {f"buf_{dir_name(self._d)}": flatten_face(sl, sizes)}
 
 
 class UnpackRecv(Unpack):
@@ -94,16 +107,12 @@ class UnpackRecv(Unpack):
     back to the face extents, then the same ghost-shell write as
     models/halo.Unpack."""
 
-    def reads(self):
-        return ["U", f"recv_{dir_name(self._d)}"]
-
     def apply(self, bufs, ctx):
         import jax.lax as lax
 
         starts, _ = _face_slices(self._args, self._d, "unpack")
         _, sizes = _face_slices(self._args, self._d, "pack")
-        n = int(np.prod(sizes))
-        face = bufs[f"recv_{dir_name(self._d)}"].reshape(-1)[:n].reshape(tuple(sizes))
+        face = unflatten_face(bufs[f"recv_{dir_name(self._d)}"], sizes)
         return {"U": lax.dynamic_update_slice(bufs["U"], face, starts)}
 
 

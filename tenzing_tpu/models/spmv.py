@@ -262,18 +262,27 @@ class SpMVPallasOp(SpMVOp):
 class SpMVImplChoice(ChoiceOp):
     """Implementation menu for one SpMV: XLA-gather vs Pallas vreg-gather
     (reference ChoiceOp, operation.hpp:90-93; the scheduler replaces it via a
-    ChooseOp decision, state.cpp:61-65)."""
+    ChooseOp decision, state.cpp:61-65).
 
-    def __init__(self, name: str, x: str, y: str, vals: str, cols: str):
+    When the x-vector length is known at graph construction (``x_size``), the
+    Pallas choice is offered only if the kernel actually supports it — otherwise
+    SpMVPallasOp would silently fall back to the XLA path and the menu would
+    double the structural-variant space with duplicate candidates (ADVICE r1)."""
+
+    def __init__(self, name: str, x: str, y: str, vals: str, cols: str,
+                 x_size: Optional[int] = None):
         super().__init__(name)
         self._args = (x, y, vals, cols)
+        self._x_size = x_size
 
     def choices(self) -> List[OpBase]:
+        from tenzing_tpu.ops.spmv_pallas import supports
+
         x, y, vals, cols = self._args
-        return [
-            SpMVOp(self.name() + ".xla", x, y, vals, cols),
-            SpMVPallasOp(self.name() + ".pallas", x, y, vals, cols),
-        ]
+        out: List[OpBase] = [SpMVOp(self.name() + ".xla", x, y, vals, cols)]
+        if self._x_size is None or supports(self._x_size):
+            out.append(SpMVPallasOp(self.name() + ".pallas", x, y, vals, cols))
+        return out
 
 
 class Scatter(DeviceOp):
@@ -340,13 +349,21 @@ class SpMVCompound(CompoundOp):
     ChoiceOps (XLA gather vs Pallas vreg-gather) and the solver searches the
     kernel menu alongside order and lane assignment."""
 
-    def __init__(self, name: str = "spmv", impl_choice: bool = False):
+    def __init__(self, name: str = "spmv", impl_choice: bool = False,
+                 x_sizes: Optional[Dict[str, int]] = None):
         super().__init__(name)
         self._impl_choice = impl_choice
+        # buffer-name -> x length, when known (prunes unsupported Pallas choices)
+        self._x_sizes = dict(x_sizes) if x_sizes else {}
 
     def graph(self) -> Graph:
         g = Graph()
-        mk = SpMVImplChoice if self._impl_choice else SpMVOp
+        if self._impl_choice:
+            def mk(name, x, y, vals, cols):
+                return SpMVImplChoice(name, x, y, vals, cols,
+                                      x_size=self._x_sizes.get(x))
+        else:
+            mk = SpMVOp
         yl = mk("spmv_local", "x_local", "y_local", "A_loc_vals", "A_loc_cols")
         scatter = Scatter("scatter", "x_local", "send_idx", "send_buf")
         exch = LocalExchange("exchange", "send_buf", "x_remote")

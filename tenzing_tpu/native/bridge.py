@@ -303,7 +303,7 @@ def lowered_graph_for(graph: Graph) -> "LoweredGraph":
     key = (
         tuple(v.eq_key() for v in verts),
         tuple(_kind_of(v) for v in verts),
-        tuple(idx[s.eq_key()] for v in verts for s in graph.succs(v)),
+        tuple(tuple(idx[s.eq_key()] for s in graph.succs(v)) for v in verts),
     )
     with _LG_CACHE_LOCK:
         lg = _LG_CACHE.get(key)

@@ -84,28 +84,34 @@ def attn_block_pallas(
     b, n, d = q.shape
     nkv = k.shape[1]
     # tile the (row-independent) update over query blocks so VMEM holds one
-    # q/state tile + the whole K/V block, never all n queries at once
-    bq = n if n <= 512 else 512
-    if n % bq:  # fall back to untiled for ragged n (small cases only)
-        bq = n
+    # q/state tile + the whole K/V block, never all n queries at once; ragged n
+    # is padded up to the tile (rows are independent, pad rows stay finite:
+    # zero q/m give s=0, alpha=1 — no NaN/inf to leak) and sliced back off
+    bq = min(n, 512)
+    pad = (-n) % bq
+    np_ = n + pad
+    if pad:
+        padw = ((0, 0), (0, pad), (0, 0))
+        q, acc, m, l = (jnp.pad(t, padw) for t in (q, acc, m, l))
     qblk = pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))
     kvblk = pl.BlockSpec((1, nkv, d), lambda i, j: (i, 0, 0))
     specs_in = [qblk, kvblk, kvblk, qblk, qblk, qblk]
     operands = (q, k, v, acc, m, l)
     out_shape = [
-        out_struct((b, n, d), acc.dtype, *operands),
-        out_struct((b, n, d), m.dtype, *operands),
-        out_struct((b, n, d), l.dtype, *operands),
+        out_struct((b, np_, d), acc.dtype, *operands),
+        out_struct((b, np_, d), m.dtype, *operands),
+        out_struct((b, np_, d), l.dtype, *operands),
     ]
     specs_out = [qblk, qblk, qblk]
     kernel = functools.partial(_attn_block_kernel, float(scale))
-    return tuple(
-        pl.pallas_call(
-            kernel,
-            grid=(b, n // bq),
-            in_specs=specs_in,
-            out_specs=specs_out,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(q, k, v, acc, m, l)
-    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(b, np_ // bq),
+        in_specs=specs_in,
+        out_specs=specs_out,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q, k, v, acc, m, l)
+    if pad:
+        outs = [o[:, :n] for o in outs]
+    return tuple(outs)

@@ -38,7 +38,12 @@ import numpy as np
 
 from tenzing_tpu.core.operation import ChoiceOp, OpBase
 from tenzing_tpu.models.halo import HaloArgs, _face_slices, dir_name
-from tenzing_tpu.models.halo_pipeline import PackFlat, UnpackRecv, _flat_rows
+from tenzing_tpu.models.halo_pipeline import (
+    PackFlat,
+    UnpackRecv,
+    flatten_face,
+    unflatten_face,
+)
 
 
 def _interpret() -> bool:
@@ -128,9 +133,7 @@ class PackPallas(PackFlat):
         out = pack_face_pallas(
             bufs["U"], tuple(starts), tuple(sizes), interpret=_interpret()
         )
-        n = int(np.prod(sizes))
-        flat = jnp.pad(out.reshape(-1), (0, _flat_rows(sizes) * 128 - n))
-        return {f"buf_{dir_name(self._d)}": flat.reshape(-1, 128)}
+        return {f"buf_{dir_name(self._d)}": flatten_face(out, sizes)}
 
     def uses_pallas(self) -> bool:
         return True
@@ -154,10 +157,7 @@ class UnpackPallas(UnpackRecv):
     def apply(self, bufs, ctx):
         starts, _ = _face_slices(self._args, self._d, "unpack")
         _, sizes = _face_slices(self._args, self._d, "pack")
-        n = int(np.prod(sizes))
-        face = (
-            bufs[f"recv_{dir_name(self._d)}"].reshape(-1)[:n].reshape(tuple(sizes))
-        )
+        face = unflatten_face(bufs[f"recv_{dir_name(self._d)}"], sizes)
         out = unpack_face_pallas(
             bufs["U"], face, tuple(starts), interpret=_interpret()
         )

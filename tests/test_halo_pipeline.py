@@ -1,6 +1,8 @@
 """Single-chip halo pipeline: post/wait split, numerics, overlap orderings,
 and the Pallas pack/unpack kernel menu."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -164,8 +166,8 @@ print("SINGLE_DEVICE_OK")
         capture_output=True,
         text=True,
         timeout=240,
-        cwd="/root/repo",
-        env={k: v for k, v in __import__("os").environ.items() if k != "XLA_FLAGS"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
     )
     assert "SINGLE_DEVICE_OK" in out.stdout, out.stdout + out.stderr
 
