@@ -56,6 +56,19 @@ def probe_backend(retries: int = 1, wait_secs: float = 15.0):
     raise AssertionError("unreachable")  # pragma: no cover
 
 
+def metric_for(workload: str, args) -> str:
+    """The metric name for a workload config — the single source both the
+    success path (build_* return) and the backend-init-failure path use, so
+    the two always land in the same metric series."""
+    if workload == "halo":
+        return f"halo_iter_pct50_searched_n{4 if args.smoke else args.halo_n}"
+    if workload == "spmv":
+        m = args.m if args.m is not None else (512 if args.smoke else 150_000)
+        return f"spmv_iter_pct50_searched_m{m}"
+    n_ctx = 4 * 16 if args.smoke else 8 * 1024
+    return f"attn_blockwise_pct50_searched_n{n_ctx}"
+
+
 def build_halo(args):
     import jax
     import jax.numpy as jnp
@@ -86,7 +99,7 @@ def build_halo(args):
     # would dominate a CPU smoke timing
     impl_choice = not args.smoke
     g = build_graph(hargs, impl_choice=impl_choice)
-    return g, jbufs, f"halo_iter_pct50_searched_n{hargs.lx}", hargs
+    return g, jbufs, metric_for("halo", args), hargs
 
 
 def build_spmv(args):
@@ -106,7 +119,7 @@ def build_spmv(args):
     g = Graph()
     g.start_then(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
     g.then_finish(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
-    return g, bufs, f"spmv_iter_pct50_searched_m{m}"
+    return g, bufs, metric_for("spmv", args)
 
 
 def build_attn(args):
@@ -129,8 +142,7 @@ def build_attn(args):
     g = Graph()
     g.start_then(BlockedAttention(aargs, impl_choice=True))
     g.then_finish(BlockedAttention(aargs, impl_choice=True))
-    n_ctx = aargs.n_devices * aargs.seq_local
-    return g, bufs, f"attn_blockwise_pct50_searched_n{n_ctx}"
+    return g, bufs, metric_for("attn", args)
 
 
 def main() -> int:
@@ -149,15 +161,7 @@ def main() -> int:
 
         jax.config.update("jax_platforms", "cpu")
 
-    # must match the metric the build_* functions return for the same config
-    halo_n = 4 if args.smoke else args.halo_n
-    spmv_m = args.m if args.m is not None else (512 if args.smoke else 150_000)
-    attn_n = 4 * 16 if args.smoke else 8 * 1024
-    metric_name = {
-        "halo": f"halo_iter_pct50_searched_n{halo_n}",
-        "spmv": f"spmv_iter_pct50_searched_m{spmv_m}",
-        "attn": f"attn_blockwise_pct50_searched_n{attn_n}",
-    }[args.workload]
+    metric_name = metric_for(args.workload, args)
     try:
         devs = probe_backend()
         sys.stderr.write(f"backend: {devs}\n")

@@ -277,14 +277,20 @@ def result_row(idx: int, res: BenchResult, order: Sequence) -> str:
 class CsvBenchmarker:
     """Answers benchmark queries from a recorded database by equivalence-matching
     the query sequence against stored schedules — search experiments with no
-    device in the loop (reference benchmarker.cpp:169-223)."""
+    device in the loop (reference benchmarker.cpp:169-223).
 
-    def __init__(self, rows: List[str], graph):
+    ``strict=False`` skips rows whose ops cannot be resolved against ``graph``
+    (recorded against a different structural variant — e.g. a naive baseline
+    dumped from the pre-choice graph); skipped row indices are kept in
+    ``self.skipped`` so callers can see what the database did not cover."""
+
+    def __init__(self, rows: List[str], graph, strict: bool = True):
         from tenzing_tpu.core.serdes import op_from_json
         import json
 
         self.entries: List[Tuple[Sequence, BenchResult]] = []
-        for row in rows:
+        self.skipped: List[int] = []
+        for i, row in enumerate(rows):
             if not row.strip():
                 continue
             cells = row.split(CSV_DELIM)
@@ -296,13 +302,19 @@ class CsvBenchmarker:
                 pct99=float(cells[5]),
                 stddev=float(cells[6]),
             )
-            ops = [op_from_json(json.loads(c), graph) for c in cells[7:]]
+            try:
+                ops = [op_from_json(json.loads(c), graph) for c in cells[7:]]
+            except (KeyError, TypeError, ValueError):
+                if strict:
+                    raise
+                self.skipped.append(i)
+                continue
             self.entries.append((Sequence(ops), res))
 
     @classmethod
-    def from_file(cls, path: str, graph) -> "CsvBenchmarker":
+    def from_file(cls, path: str, graph, strict: bool = True) -> "CsvBenchmarker":
         with open(path) as f:
-            return cls(f.read().splitlines(), graph)
+            return cls(f.read().splitlines(), graph, strict=strict)
 
     def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
         for stored, res in self.entries:

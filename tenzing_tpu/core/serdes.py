@@ -18,6 +18,10 @@ import json
 from typing import Any, Dict, List, Optional
 
 from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core import sync_ops as _sync_ops  # noqa: F401 — registers the
+# scheduler-inserted sync-op kinds; without this, resolving a serialized
+# event_record/event_sync would depend on whether the caller happened to import
+# sync_ops first
 from tenzing_tpu.core.operation import (
     ChoiceOp,
     CompoundOp,

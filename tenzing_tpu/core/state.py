@@ -30,8 +30,23 @@ from tenzing_tpu.core.resources import Equivalence, Lane
 from tenzing_tpu.core.sequence import Sequence
 
 
+def _freeze(obj) -> Any:
+    """JSON-able value -> hashable key with the same equality."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    return obj
+
+
 class Decision:
-    """Base decision (reference decision.hpp:13-20)."""
+    """Base decision (reference decision.hpp:13-20).
+
+    Equality/hash is by JSON content (resource-sensitive: two syncs on
+    different lanes are different decisions), via a key frozen once per
+    instance — decisions are compared and deduped hot in the solvers."""
+
+    _key: Optional[tuple] = None
 
     def desc(self) -> str:
         raise NotImplementedError
@@ -39,13 +54,16 @@ class Decision:
     def to_json(self) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def key(self) -> tuple:
+        if self._key is None:
+            self._key = (type(self).__name__, _freeze(self.to_json()))
+        return self._key
+
     def __eq__(self, other: object) -> bool:
-        return type(self) is type(other) and self.to_json() == other.to_json()
+        return isinstance(other, Decision) and self.key() == other.key()
 
     def __hash__(self) -> int:
-        import json
-
-        return hash(json.dumps(self.to_json(), sort_keys=True))
+        return hash(self.key())
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return self.desc()
@@ -142,9 +160,12 @@ class State:
             else:  # pragma: no cover - defensive
                 raise TypeError(f"frontier op of unknown kind: {op!r}")
         # dedup identical decisions (e.g. the same sync demanded by two frontier ops)
+        seen = set()
         out: List[Decision] = []
         for d in decisions:
-            if d not in out:
+            k = d.key()
+            if k not in seen:
+                seen.add(k)
                 out.append(d)
         return out
 

@@ -81,6 +81,28 @@ def get_all_sequences(
     return terminals
 
 
+def get_unique_sequences(
+    graph: Graph, platform, max_seqs: int = 15000
+) -> List[State]:
+    """Like :func:`get_all_sequences`, but terminals are deduplicated under
+    resource bijection *as they are found* and ``max_seqs`` counts unique
+    terminals — the same cap semantics as the native core
+    (native/src/core.cpp enumerate_sequences), so ``TENZING_TPU_NATIVE=0``
+    and ``=1`` see the same capped terminal set for the same budget."""
+    uniq: List[State] = []
+    stack: List[State] = [State(graph)]
+    while stack and len(uniq) < max_seqs:
+        st = stack.pop()
+        if st.is_terminal():
+            if not any(
+                sequence_mod.get_equivalence(st.sequence, u.sequence) for u in uniq
+            ):
+                uniq.append(st)
+            continue
+        stack.extend(st.frontier(platform))
+    return uniq
+
+
 def expand_all(graph: Graph) -> Graph:
     """Inline every CompoundOp.  An ExpandOp is the only decision available for
     a frontier compound and commutes with execution order, so eager expansion
@@ -114,9 +136,9 @@ def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000) -> List[S
     enumerated by the native (C++) core when available, else by the Python
     path.  The ``max_seqs`` budget is fair-shared across variants (a huge first
     variant must not starve the others out of the search entirely); unused
-    share flows to later variants.  Note the cap counts *deduplicated*
-    terminals on the native path and raw terminals on the Python path (the
-    native behaviour is strictly more productive)."""
+    share flows to later variants.  Both paths count *deduplicated* terminals
+    against the cap (same semantics either way; cross-checked in
+    tests/test_native.py)."""
     import sys
 
     from tenzing_tpu.native import bridge
@@ -134,12 +156,9 @@ def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000) -> List[S
             break
         share = -(-remaining // (len(variants) - k))  # ceil fair share
         nat = bridge.try_enumerate(g, platform, share, dedup_terminals=True)
-        if nat is not None:
-            truncated = len(nat) >= share
-        else:
-            raw = get_all_sequences(g, platform, share)
-            truncated = len(raw) >= share  # raw count, before dedup shrinks it
-            nat = _dedup_terminal_states(raw)
+        if nat is None:
+            nat = get_unique_sequences(g, platform, share)
+        truncated = len(nat) >= share
         if truncated and k + 1 < len(variants):
             print(
                 f"tenzing-tpu: dfs variant {k} truncated at its fair share "

