@@ -294,17 +294,19 @@ class CsvBenchmarker:
             if not row.strip():
                 continue
             cells = row.split(CSV_DELIM)
-            res = BenchResult(
-                pct01=float(cells[1]),
-                pct10=float(cells[2]),
-                pct50=float(cells[3]),
-                pct90=float(cells[4]),
-                pct99=float(cells[5]),
-                stddev=float(cells[6]),
-            )
             try:
+                res = BenchResult(
+                    pct01=float(cells[1]),
+                    pct10=float(cells[2]),
+                    pct50=float(cells[3]),
+                    pct90=float(cells[4]),
+                    pct99=float(cells[5]),
+                    stddev=float(cells[6]),
+                )
                 ops = [op_from_json(json.loads(c), graph) for c in cells[7:]]
-            except (KeyError, TypeError, ValueError):
+            except (KeyError, TypeError, ValueError, IndexError):
+                # malformed row (e.g. dump truncated mid-write) or ops recorded
+                # against a different structural variant
                 if strict:
                     raise
                 self.skipped.append(i)

@@ -1,0 +1,280 @@
+"""Distributed SpMV with a general irregular remote-column exchange.
+
+Parity target: reference ``RowPartSpmv`` setup for *arbitrary* sparsity —
+the root splits local vs remote columns and negotiates per-rank send/recv
+column lists with an Isend/Probe/Recv handshake
+(row_part_spmv.cuh:259-423), then the schedule overlaps per-neighbor
+PostSend/PostRecv/WaitRecv comm ops (ops_spmv.cuh:217-304) with the local
+SpMV.  ``models/spmv_dist.py`` covers only band matrices whose remote columns
+live in adjacent shards; this module handles any sparsity pattern
+(VERDICT r1 item 3).
+
+TPU-native redesign.  There is no ragged all-to-all on ICI, so the negotiated
+exchange is realized as **per-distance permute steps** over the ``sp`` ring:
+
+* **Setup (host-side numpy — the negotiation analog).**  For every requester
+  shard ``p`` and cyclic distance ``d``, the send list ``S_d[p]`` is the
+  sorted set of global columns that ``p``'s rows reference and shard
+  ``(p-d) % n_sp`` owns.  Because setup is host-global (the driver holds the
+  whole matrix, like the reference root), the Isend/Probe/Recv handshake
+  collapses to array arithmetic; what is preserved is its *product*: exact
+  per-pair column lists, gather index slabs, and a remote-column renumbering
+  into a contiguous halo buffer (split_mat.hpp:22-136).
+* **Data plane (schedulable ops).**  Distances with empty lists everywhere are
+  dropped; for each retained ``d``:
+  ``gather_d`` (DeviceOp, lane-searched — the reference Scatter,
+  ops_spmv.cuh:194-215) packs the requested x entries into a width-padded
+  send buffer; ``permute_d`` (PermuteStart — the post half of
+  Isend/Irecv) shifts it ``d`` hops over ICI; ``await_d`` (AwaitTransfer —
+  the reference WaitRecv) joins completion into the host chain.  The solver
+  schedules compute between every post and its await.
+* A band matrix fed through this path naturally degenerates to the two
+  adjacent-distance steps of ``spmv_dist.py`` — the static-neighbor case is
+  just the irregular machinery with ``steps = [1, n_sp-1]``.
+
+Graph shape (reference SpMV compound, ops_spmv.cuh:306-436):
+
+    start -> spmv_local ----------------------------> y_add -> finish
+    start -> gather_d -> permute_d -> await_d -+
+                          (one chain per d)    +-> spmv_halo -> y_add
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+from tenzing_tpu.models.spmv import CooMat, CsrMat
+from tenzing_tpu.models.spmv_dist import AddShards, SpMVLocalShard
+from tenzing_tpu.ops.comm_ops import AwaitTransfer, PermuteStart
+
+
+@dataclass
+class ExchangePlan:
+    """The negotiated exchange: everything the reference's setup handshake
+    produces (row_part_spmv.cuh:259-423), computed host-side.
+
+    ``send_lists[d][p]`` — sorted global columns shard ``p`` receives from
+    shard ``(p-d) % n_sp`` at distance ``d`` (the reference's recv list; the
+    sender's send list is the same array read from the other side).
+    """
+
+    n_sp: int
+    block: int
+    steps: List[int] = field(default_factory=list)
+    widths: Dict[int, int] = field(default_factory=dict)
+    send_lists: Dict[int, List[np.ndarray]] = field(default_factory=dict)
+    offsets: Dict[int, int] = field(default_factory=dict)
+    halo_width: int = 0
+
+    def owner(self, col: int) -> int:
+        return min(int(col) // self.block, self.n_sp - 1)
+
+    def halo_slot(self, p: int, col: int) -> int:
+        """Position of global column ``col`` in requester ``p``'s halo buffer."""
+        q = self.owner(col)
+        d = (p - q) % self.n_sp
+        lst = self.send_lists[d][p]
+        j = int(np.searchsorted(lst, col))
+        assert j < len(lst) and lst[j] == col, (p, col, d)
+        return self.offsets[d] + j
+
+
+def negotiate_exchange(a: CsrMat, n_sp: int) -> ExchangePlan:
+    """Compute per-(requester, distance) column lists for arbitrary sparsity —
+    the host-side product of the reference's send/recv negotiation
+    (row_part_spmv.cuh:259-423 Isend/Probe/Recv handshake)."""
+    assert a.m % n_sp == 0, "rows must divide evenly across sp shards"
+    block = a.m // n_sp
+    plan = ExchangePlan(n_sp=n_sp, block=block)
+    needed: List[List[np.ndarray]] = [[np.array([], dtype=np.int64)] * n_sp
+                                      for _ in range(n_sp)]  # [d][p]
+    for p in range(n_sp):
+        lo, hi = p * block, (p + 1) * block
+        rows = a.retain_rows(lo, hi)
+        cols = np.unique(rows.cols.astype(np.int64))
+        remote = cols[(cols < lo) | (cols >= hi)]
+        owners = np.minimum(remote // block, n_sp - 1)
+        for q in np.unique(owners):
+            d = (p - int(q)) % n_sp
+            needed[d][p] = remote[owners == q]  # sorted (np.unique order)
+    off = 0
+    for d in range(1, n_sp):
+        w = max((len(needed[d][p]) for p in range(n_sp)), default=0)
+        if w == 0:
+            continue
+        plan.steps.append(d)
+        plan.widths[d] = w
+        plan.send_lists[d] = needed[d]
+        plan.offsets[d] = off
+        off += w
+    plan.halo_width = max(1, off)
+    return plan
+
+
+class GatherSend(DeviceOp):
+    """Pack the x entries a distance-``d`` receiver asked for into the padded
+    send buffer (reference Scatter, ops_spmv.cuh:194-215: gather owned x into
+    the send buf the Isend ships)."""
+
+    def __init__(self, name: str, d: int):
+        super().__init__(name)
+        self._d = d
+
+    def reads(self):
+        return ["X", f"send_idx_{self._d}"]
+
+    def writes(self):
+        return [f"send_{self._d}"]
+
+    def apply(self, bufs, ctx):
+        idx = bufs[f"send_idx_{self._d}"][0]  # (w_d,) this shard's gather list
+        return {f"send_{self._d}": bufs["X"][:, idx]}
+
+
+class SpMVHaloIrregular(DeviceOp):
+    """Y_rem against the concatenated received halo segments (reference yr
+    SpMVKernel over the renumbered remote matrix, ops_spmv.cuh:398-401)."""
+
+    def __init__(self, name: str, steps: List[int]):
+        super().__init__(name)
+        self._steps = list(steps)
+
+    def reads(self):
+        return [f"recv_{d}" for d in self._steps] + ["A_rem_vals", "A_rem_cols"]
+
+    def writes(self):
+        return ["Y_rem"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        halo = jnp.concatenate([bufs[f"recv_{d}"] for d in self._steps], axis=1)
+        rv, rc = bufs["A_rem_vals"], bufs["A_rem_cols"]
+        return {"Y_rem": jnp.einsum("rw,brw->br", rv, halo[:, rc])}
+
+
+class IrregularSpMV(CompoundOp):
+    """The whole irregular-exchange SpMV iteration as one compound op.
+    ``steps`` must match the plan the buffers were built with."""
+
+    def __init__(self, steps: List[int], name: str = "irr_spmv"):
+        super().__init__(name)
+        self._steps = list(steps)
+
+    def graph(self) -> Graph:
+        g = Graph()
+        loc = SpMVLocalShard("spmv_local")
+        add = AddShards("y_add")
+        if not self._steps:  # block-diagonal matrix: nothing to exchange
+            g.start_then(loc)
+            g.then(loc, add)  # Y_rem stays the declared zero buffer
+            g.then_finish(add)
+            return g
+        halo = SpMVHaloIrregular("spmv_halo", self._steps)
+        g.start_then(loc)
+        for d in self._steps:
+            gather = GatherSend(f"gather_{d}", d)
+            post = PermuteStart(
+                f"permute_{d}", f"send_{d}", f"recv_{d}", axis="sp", shift=d
+            )
+            await_ = AwaitTransfer(f"await_{d}", f"recv_{d}")
+            g.start_then(gather)
+            g.then(gather, post)
+            g.then(post, await_)
+            g.then(await_, halo)
+        g.then(loc, add)
+        g.then(halo, add)
+        g.then_finish(add)
+        return g
+
+
+def make_irregular_spmv_buffers(
+    a: CsrMat,
+    n_sp: int,
+    batch: int = 8,
+    seed: int = 0,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray, ExchangePlan]:
+    """(buffers, partition specs, expected Y, plan) for an arbitrary-sparsity
+    square matrix row-partitioned over ``n_sp`` shards on a ("dp", "sp") mesh.
+
+    The local slab gathers from the owned x block; the remote slab's columns
+    are renumbered into the contiguous halo layout the retained permute steps
+    deliver (reference split_local_remote renumbering, split_mat.hpp:22-136)."""
+    from jax.sharding import PartitionSpec as P
+
+    assert a.m == a.n, "square matrix (y and x share the row partition)"
+    plan = negotiate_exchange(a, n_sp)
+    block = plan.block
+
+    loc_slabs, rem_slabs = [], []
+    for p in range(n_sp):
+        lo, hi = p * block, (p + 1) * block
+        rows = a.retain_rows(lo, hi)
+        l_r, l_c, l_v = [], [], []
+        r_r, r_c, r_v = [], [], []
+        for i in range(rows.m):
+            for j in range(rows.indptr[i], rows.indptr[i + 1]):
+                c = int(rows.cols[j])
+                if lo <= c < hi:
+                    l_r.append(i); l_c.append(c - lo); l_v.append(rows.vals[j])
+                else:
+                    r_r.append(i); r_c.append(plan.halo_slot(p, c))
+                    r_v.append(rows.vals[j])
+        loc_slabs.append(CooMat(rows.m, block, np.array(l_r, dtype=np.int64),
+                                np.array(l_c, dtype=np.int64),
+                                np.array(l_v, dtype=np.float32)).to_csr())
+        rem_slabs.append(CooMat(rows.m, plan.halo_width,
+                                np.array(r_r, dtype=np.int64),
+                                np.array(r_c, dtype=np.int64),
+                                np.array(r_v, dtype=np.float32)).to_csr())
+    wl = max(1, max(int(s.row_widths().max(initial=0)) for s in loc_slabs))
+    wr = max(1, max(int(s.row_widths().max(initial=0)) for s in rem_slabs))
+    lv = np.concatenate([s.to_slab(wl)[0] for s in loc_slabs])
+    lc = np.concatenate([s.to_slab(wl)[1] for s in loc_slabs])
+    rv = np.concatenate([s.to_slab(wr)[0] for s in rem_slabs])
+    rc = np.concatenate([s.to_slab(wr)[1] for s in rem_slabs])
+
+    rng = np.random.default_rng(seed + 1)
+    X = rng.random((batch, a.m), dtype=np.float32)
+    want = np.stack([a.matvec(X[b]) for b in range(batch)])
+
+    bufs: Dict[str, np.ndarray] = {
+        "X": X,
+        "A_loc_vals": lv,
+        "A_loc_cols": lc.astype(np.int32),
+        "A_rem_vals": rv,
+        "A_rem_cols": rc.astype(np.int32),
+        "Y_loc": np.zeros_like(X),
+        "Y_rem": np.zeros_like(X),
+        "Y": np.zeros_like(X),
+    }
+    specs: Dict[str, object] = {
+        "X": P("dp", "sp"),
+        "A_loc_vals": P("sp", None),
+        "A_loc_cols": P("sp", None),
+        "A_rem_vals": P("sp", None),
+        "A_rem_cols": P("sp", None),
+        "Y_loc": P("dp", "sp"),
+        "Y_rem": P("dp", "sp"),
+        "Y": P("dp", "sp"),
+    }
+    for d in plan.steps:
+        w = plan.widths[d]
+        idx = np.zeros((n_sp, w), dtype=np.int32)
+        for q in range(n_sp):
+            # sender q serves receiver (q+d) % n_sp: gather that receiver's
+            # list (all owned by q) out of q's local x block
+            lst = plan.send_lists[d][(q + d) % n_sp]
+            idx[q, : len(lst)] = lst - q * block
+        bufs[f"send_idx_{d}"] = idx
+        bufs[f"send_{d}"] = np.zeros((batch, n_sp * w), dtype=np.float32)
+        bufs[f"recv_{d}"] = np.zeros((batch, n_sp * w), dtype=np.float32)
+        specs[f"send_idx_{d}"] = P("sp", None)
+        specs[f"send_{d}"] = P("dp", "sp")
+        specs[f"recv_{d}"] = P("dp", "sp")
+    return bufs, specs, want, plan

@@ -349,22 +349,38 @@ class TraceExecutor:
                 return (tc.bufs, tc.token_state())
 
             mesh = self.platform.mesh
+
+            def loop(bufs: Dict[str, Any], n) -> Dict[str, Any]:
+                toks = tok0
+                if mesh is not None:
+                    # comm ops make tokens shard-varying mid-loop; the carry
+                    # type must be varying from iteration 0
+                    toks = jax.tree_util.tree_map(
+                        lambda t: lax.pcast(t, tuple(mesh.axis_names), to="varying"),
+                        toks,
+                    )
+                out, _ = lax.fori_loop(0, n, lambda i, s: body(s), (bufs, toks))
+                return out
+
             if mesh is not None:
+                # the whole sample loop runs inside one shard_map region: the
+                # token carry is per-shard state (comm-op tokens vary across
+                # mesh axes) and must not cross the shard_map boundary, where
+                # it would need a replicated out_spec it cannot satisfy
                 specs = {name: self.platform.spec(name) for name in self.init_bufs}
                 from jax.sharding import PartitionSpec
 
-                tok_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(), tok0)
                 kw = {"check_vma": False} if self._has_pallas(ops) else {}
-                body = jax.shard_map(
-                    body,
+                loop = jax.shard_map(
+                    loop,
                     mesh=mesh,
-                    in_specs=((specs, tok_specs),),
-                    out_specs=(specs, tok_specs),
+                    in_specs=(specs, PartitionSpec()),
+                    out_specs=specs,
                     **kw,
                 )
 
             def stepped(bufs: Dict[str, Any], n) -> Any:
-                out, _ = lax.fori_loop(0, n, lambda i, s: body(s), (bufs, tok0))
+                out = loop(bufs, n)
                 fence = jnp.zeros((), jnp.float32)
                 host_outs = {}
                 for name, val in out.items():

@@ -64,21 +64,35 @@ class DfsResult:
         return min(self.sims, key=lambda s: s.result.pct10)
 
 
-def get_all_sequences(
-    graph: Graph, platform, max_seqs: int = 15000
+def _dfs_terminals(
+    graph: Graph, platform, max_seqs: int, dedup_terminals: bool
 ) -> List[State]:
-    """All complete schedules reachable from the initial state, deduplicating
-    equivalent states at every expansion (reference get_all_sequences,
-    dfs.cpp:16-82; the per-expansion dedup is dfs.cpp:46-58)."""
+    """Worklist DFS over ``State.frontier`` (reference get_all_sequences,
+    dfs.cpp:16-82; the per-expansion dedup is dfs.cpp:46-58).  With
+    ``dedup_terminals`` the cap counts bijection-unique terminals."""
     terminals: List[State] = []
     stack: List[State] = [State(graph)]
     while stack and len(terminals) < max_seqs:
         st = stack.pop()
         if st.is_terminal():
+            if dedup_terminals and any(
+                sequence_mod.get_equivalence(st.sequence, u.sequence)
+                for u in terminals
+            ):
+                continue
             terminals.append(st)
             continue
         stack.extend(st.frontier(platform))
     return terminals
+
+
+def get_all_sequences(
+    graph: Graph, platform, max_seqs: int = 15000
+) -> List[State]:
+    """All complete schedules reachable from the initial state (terminal
+    duplicates across converging DFS paths included; ``max_seqs`` caps raw
+    terminals)."""
+    return _dfs_terminals(graph, platform, max_seqs, dedup_terminals=False)
 
 
 def get_unique_sequences(
@@ -89,18 +103,7 @@ def get_unique_sequences(
     terminals — the same cap semantics as the native core
     (native/src/core.cpp enumerate_sequences), so ``TENZING_TPU_NATIVE=0``
     and ``=1`` see the same capped terminal set for the same budget."""
-    uniq: List[State] = []
-    stack: List[State] = [State(graph)]
-    while stack and len(uniq) < max_seqs:
-        st = stack.pop()
-        if st.is_terminal():
-            if not any(
-                sequence_mod.get_equivalence(st.sequence, u.sequence) for u in uniq
-            ):
-                uniq.append(st)
-            continue
-        stack.extend(st.frontier(platform))
-    return uniq
+    return _dfs_terminals(graph, platform, max_seqs, dedup_terminals=True)
 
 
 def expand_all(graph: Graph) -> Graph:
