@@ -42,15 +42,15 @@ Graph shape (reference SpMV compound, ops_spmv.cuh:306-436):
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from tenzing_tpu.core.graph import Graph
-from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+from tenzing_tpu.core.operation import ChoiceOp, CompoundOp, DeviceOp, OpBase
 from tenzing_tpu.models.spmv import CooMat, CsrMat
 from tenzing_tpu.models.spmv_dist import AddShards, SpMVLocalShard
-from tenzing_tpu.ops.comm_ops import AwaitTransfer, PermuteStart
+from tenzing_tpu.ops.comm_ops import AllToAllStart, AwaitTransfer, PermuteStart
 
 
 @dataclass
@@ -158,13 +158,139 @@ class SpMVHaloIrregular(DeviceOp):
         return {"Y_rem": jnp.einsum("rw,brw->br", rv, halo[:, rc])}
 
 
-class IrregularSpMV(CompoundOp):
-    """The whole irregular-exchange SpMV iteration as one compound op.
-    ``steps`` must match the plan the buffers were built with."""
+class GatherAll(DeviceOp):
+    """Pack every receiver's requested entries into the (n_sp, w_max) send
+    matrix the all-to-all ships (the Scatter of the Ialltoallv path)."""
 
-    def __init__(self, steps: List[int], name: str = "irr_spmv"):
+    def reads(self):
+        return ["X", "send_idx_all"]
+
+    def writes(self):
+        return ["send_all"]
+
+    def apply(self, bufs, ctx):
+        idx = bufs["send_idx_all"][0]  # (n_sp, w_max) this shard's lists
+        return {"send_all": bufs["X"][:, idx]}
+
+
+class UnpackA2A(DeviceOp):
+    """Split the all-to-all result back into the per-distance recv buffers, so
+    downstream ops are identical to the permute path (same halo layout)."""
+
+    def __init__(self, name: str, steps: List[int], widths: Dict[int, int]):
         super().__init__(name)
         self._steps = list(steps)
+        self._widths = dict(widths)
+
+    def reads(self):
+        return ["recv_a2a"]
+
+    def writes(self):
+        return [f"recv_{d}" for d in self._steps]
+
+    def apply(self, bufs, ctx):
+        import jax
+        from jax import lax
+
+        out = bufs["recv_a2a"]  # (b, n_sp, w_max): row q = sent by shard q
+        p = lax.axis_index("sp")
+        n = lax.axis_size("sp")
+        res = {}
+        for d in self._steps:
+            row = lax.dynamic_index_in_dim(out, (p - d) % n, axis=1, keepdims=False)
+            res[f"recv_{d}"] = row[:, : self._widths[d]]
+        return res
+
+
+def _add_distance_chain(g: Graph, d: int, preds: List, succs: List) -> None:
+    """Wire one gather -> permute-start -> await chain for distance ``d``
+    between ``preds`` and ``succs`` (shared by the plain and choice paths)."""
+    gather = GatherSend(f"gather_{d}", d)
+    post = PermuteStart(f"permute_{d}", f"send_{d}", f"recv_{d}", axis="sp", shift=d)
+    await_ = AwaitTransfer(f"await_{d}", f"recv_{d}")
+    for p in preds:
+        g.then(p, gather)
+    g.then(gather, post)
+    g.then(post, await_)
+    for s in succs:
+        g.then(await_, s)
+
+
+class PermuteExchange(CompoundOp):
+    """Exchange via one gather -> permute-start -> await chain per retained
+    cyclic distance (per-neighbor Isend/Irecv shape)."""
+
+    def __init__(self, steps: List[int], name: str = "exchange.permute"):
+        super().__init__(name)
+        self._steps = list(steps)
+
+    def graph(self) -> Graph:
+        g = Graph()
+        for d in self._steps:
+            _add_distance_chain(g, d, [g.start()], [g.finish()])
+        return g
+
+
+class A2AExchange(CompoundOp):
+    """Exchange via one padded all-to-all (the reference Ialltoallv,
+    ops_mpi.hpp:82-119): gather-all -> a2a-start -> await -> unpack."""
+
+    def __init__(self, steps: List[int], widths: Dict[int, int],
+                 name: str = "exchange.a2a"):
+        super().__init__(name)
+        self._steps = list(steps)
+        self._widths = dict(widths)
+
+    def graph(self) -> Graph:
+        g = Graph()
+        gather = GatherAll("gather_all")
+        post = AllToAllStart("a2a_post", "send_all", "recv_a2a", axis="sp")
+        await_ = AwaitTransfer("a2a_await", "recv_a2a")
+        unpack = UnpackA2A("a2a_unpack", self._steps, self._widths)
+        g.start_then(gather)
+        g.then(gather, post)
+        g.then(post, await_)
+        g.then(await_, unpack)
+        g.then_finish(unpack)
+        return g
+
+
+class ExchangeChoice(ChoiceOp):
+    """The exchange-implementation menu: per-distance permutes vs one padded
+    all-to-all — which wins depends on how many distances are live and how
+    ragged the lists are, so it is the solver's question."""
+
+    def __init__(self, steps: List[int], widths: Dict[int, int],
+                 name: str = "exchange"):
+        super().__init__(name)
+        self._steps = list(steps)
+        self._widths = dict(widths)
+
+    def choices(self) -> List[OpBase]:
+        return [
+            PermuteExchange(self._steps),
+            A2AExchange(self._steps, self._widths),
+        ]
+
+
+class IrregularSpMV(CompoundOp):
+    """The whole irregular-exchange SpMV iteration as one compound op.
+    ``steps``/``widths`` must match the plan the buffers were built with.
+    With ``impl_choice=True`` the exchange realization becomes a ChoiceOp
+    (requires buffers built with ``impl_choice=True`` too)."""
+
+    def __init__(self, steps: List[int], name: str = "irr_spmv",
+                 widths: Optional[Dict[int, int]] = None,
+                 impl_choice: bool = False):
+        super().__init__(name)
+        self._steps = list(steps)
+        self._widths = dict(widths) if widths else {}
+        self._impl_choice = impl_choice
+        if impl_choice and steps and not self._widths:
+            raise ValueError(
+                "impl_choice=True needs widths=plan.widths (the a2a unpack "
+                "slices each distance's segment by its negotiated width)"
+            )
 
     def graph(self) -> Graph:
         g = Graph()
@@ -177,16 +303,13 @@ class IrregularSpMV(CompoundOp):
             return g
         halo = SpMVHaloIrregular("spmv_halo", self._steps)
         g.start_then(loc)
-        for d in self._steps:
-            gather = GatherSend(f"gather_{d}", d)
-            post = PermuteStart(
-                f"permute_{d}", f"send_{d}", f"recv_{d}", axis="sp", shift=d
-            )
-            await_ = AwaitTransfer(f"await_{d}", f"recv_{d}")
-            g.start_then(gather)
-            g.then(gather, post)
-            g.then(post, await_)
-            g.then(await_, halo)
+        if self._impl_choice:
+            exch = ExchangeChoice(self._steps, self._widths)
+            g.start_then(exch)
+            g.then(exch, halo)
+        else:
+            for d in self._steps:
+                _add_distance_chain(g, d, [g.start()], [halo])
         g.then(loc, add)
         g.then(halo, add)
         g.then_finish(add)
@@ -198,6 +321,7 @@ def make_irregular_spmv_buffers(
     n_sp: int,
     batch: int = 8,
     seed: int = 0,
+    impl_choice: bool = False,
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray, ExchangePlan]:
     """(buffers, partition specs, expected Y, plan) for an arbitrary-sparsity
     square matrix row-partitioned over ``n_sp`` shards on a ("dp", "sp") mesh.
@@ -234,10 +358,12 @@ def make_irregular_spmv_buffers(
                                 np.array(r_v, dtype=np.float32)).to_csr())
     wl = max(1, max(int(s.row_widths().max(initial=0)) for s in loc_slabs))
     wr = max(1, max(int(s.row_widths().max(initial=0)) for s in rem_slabs))
-    lv = np.concatenate([s.to_slab(wl)[0] for s in loc_slabs])
-    lc = np.concatenate([s.to_slab(wl)[1] for s in loc_slabs])
-    rv = np.concatenate([s.to_slab(wr)[0] for s in rem_slabs])
-    rc = np.concatenate([s.to_slab(wr)[1] for s in rem_slabs])
+    lslabs = [s.to_slab(wl) for s in loc_slabs]
+    rslabs = [s.to_slab(wr) for s in rem_slabs]
+    lv = np.concatenate([v for v, _ in lslabs])
+    lc = np.concatenate([c for _, c in lslabs])
+    rv = np.concatenate([v for v, _ in rslabs])
+    rc = np.concatenate([c for _, c in rslabs])
 
     rng = np.random.default_rng(seed + 1)
     X = rng.random((batch, a.m), dtype=np.float32)
@@ -277,4 +403,22 @@ def make_irregular_spmv_buffers(
         specs[f"send_idx_{d}"] = P("sp", None)
         specs[f"send_{d}"] = P("dp", "sp")
         specs[f"recv_{d}"] = P("dp", "sp")
+    if impl_choice and plan.steps:
+        # the padded all-to-all alternative (ExchangeChoice): per-pair lists in
+        # one (n_sp, w_max) send matrix per shard
+        wmax = max(plan.widths[d] for d in plan.steps)
+        idx_all = np.zeros((n_sp, n_sp, wmax), dtype=np.int32)
+        for q in range(n_sp):
+            for r in range(n_sp):
+                d = (r - q) % n_sp
+                if d not in plan.widths:
+                    continue
+                lst = plan.send_lists[d][r]  # what q ships to r (owned by q)
+                idx_all[q, r, : len(lst)] = lst - q * block
+        bufs["send_idx_all"] = idx_all
+        bufs["send_all"] = np.zeros((batch, n_sp * n_sp, wmax), dtype=np.float32)
+        bufs["recv_a2a"] = np.zeros((batch, n_sp * n_sp, wmax), dtype=np.float32)
+        specs["send_idx_all"] = P("sp", None, None)
+        specs["send_all"] = P("dp", "sp", None)
+        specs["recv_a2a"] = P("dp", "sp", None)
     return bufs, specs, want, plan

@@ -160,6 +160,36 @@ class PermuteStart(CommStart):
         return j
 
 
+@register_kind("all_to_all_start")
+class AllToAllStart(CommStart):
+    """Post a width-padded all-to-all over mesh axis ``axis`` — the reference
+    ``Ialltoallv`` (ops_mpi.hpp:82-119), with raggedness handled by padding
+    each pairwise segment to the common width (there is no ragged all-to-all
+    on ICI).  ``src``/``dst`` are (batch, n, w)-per-shard buffers whose
+    ``split_axis`` indexes the peer shard: out[:, q, :] is what shard q sent
+    here."""
+
+    def __init__(self, name: str, src: str, dst: str, axis: str,
+                 split_axis: int = 1):
+        super().__init__(name, src, dst)
+        self._axis = axis
+        self._split = split_axis
+
+    def apply(self, bufs, ctx):
+        import jax
+
+        return {
+            self._dst: jax.lax.all_to_all(
+                bufs[self._src], self._axis, self._split, self._split
+            )
+        }
+
+    def to_json(self) -> Dict[str, Any]:
+        j = super().to_json()
+        j.update(axis=self._axis, split_axis=self._split)
+        return j
+
+
 @register_kind("await_transfer")
 class AwaitTransfer(CpuOp):
     """Wait for an in-flight buffer: joins its completion into the host chain

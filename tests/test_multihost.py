@@ -73,10 +73,16 @@ def test_two_process_dfs_explore():
         for pid in (0, 1)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"rank failed:\n{out}\n{err}"
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"rank failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        for p in procs:  # a hung rank must not outlive the test
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     fp0 = [l for l in outs[0].splitlines() if l.startswith("RANK0_OK")]
     fp1 = [l for l in outs[1].splitlines() if l.startswith("RANK1_OK")]
     assert fp0 and fp1

@@ -97,6 +97,40 @@ def test_block_diagonal_needs_no_exchange():
     np.testing.assert_allclose(outs[0], want, rtol=2e-3)
 
 
+def test_exchange_impl_choice_both_variants_correct():
+    """With impl_choice the exchange realization is a ChoiceOp: per-distance
+    permutes vs one padded all-to-all (the Ialltoallv analog,
+    ops_mpi.hpp:82-119).  Both structural variants must be enumerated and
+    produce the right Y."""
+    from tenzing_tpu.solve.dfs import structural_variants
+
+    a = random_matrix(64, 64, 500, seed=9)
+    bufs, specs, want, plan = make_irregular_spmv_buffers(
+        a, n_sp=4, batch=2, impl_choice=True
+    )
+    g = Graph()
+    g.start_then(IrregularSpMV(plan.steps, widths=plan.widths, impl_choice=True))
+    g.then_finish(IrregularSpMV(plan.steps, widths=plan.widths, impl_choice=True))
+    variants = structural_variants(g)
+    assert len(variants) == 2
+    names = {
+        frozenset(v.desc() for v in var.vertices() if "a2a" in v.desc())
+        for var in variants
+    }
+    assert frozenset() in names  # the permute variant has no a2a ops
+    assert any(ns for ns in names)  # and the a2a variant does
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "sp"))
+    plat = Platform.make_n_lanes(2, mesh=mesh, specs=specs)
+    ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+    for var in variants:
+        st = get_all_sequences(var, plat, max_seqs=1)[0]
+        np.testing.assert_allclose(
+            np.asarray(ex.run(st.sequence)["Y"]), want, rtol=2e-3
+        )
+
+
 def test_post_wait_overlap_orderings_exist():
     """The enumerated space must contain schedules where compute sits between a
     permute post and its await — the overlap freedom the split exists for
