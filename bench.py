@@ -14,10 +14,15 @@ Workloads (``--workload``):
   the kernel menu (XLA vs Pallas MXU) plus order x lane space.
 
 The search is anytime and starts from the naive incumbent: MCTS (FastMin
-strategy) spends a fixed compile budget exploring the schedule space; the
-reported best is min over {naive} + searched candidates, so vs_baseline >= 1
-and exceeds 1 exactly when the search discovers a schedule faster than the
-naive sequential order.
+strategy) spends a fixed compile budget exploring the schedule space.  The
+verdict comes from a decorrelated *final batch* (reference batch benchmark,
+benchmarker.cpp:21-76): naive and the top distinct candidates are re-measured
+together, visited in a fresh random order per iteration, and ``vs_baseline``
+is the best candidate's **paired per-iteration speedup** over naive (median of
+naive[k]/cand[k] with a bootstrap CI, utils.numeric.paired_speedup) — drift
+common to both schedules cancels instead of masquerading as, or drowning, a
+schedule difference.  vs_baseline >= 1, exceeding 1 exactly when the search
+discovers a schedule faster than naive under the paired measurement.
 
 Prints ONE JSON line:
   {"metric": ..., "value": <best pct50, us>, "unit": "us",
@@ -151,7 +156,7 @@ def main() -> int:
     ap.add_argument("--workload", choices=("halo", "spmv", "attn"), default="halo")
     ap.add_argument("--m", type=int, default=None, help="matrix rows (spmv)")
     ap.add_argument("--halo-n", type=int, default=512, help="cells per side (halo)")
-    ap.add_argument("--mcts-iters", type=int, default=12, help="MCTS iterations (compile budget)")
+    ap.add_argument("--mcts-iters", type=int, default=24, help="MCTS iterations (compile budget)")
     ap.add_argument("--iters", type=int, default=20, help="measurements per schedule")
     ap.add_argument("--dump-csv", default=None, help="write searched results as CSV rows")
     args = ap.parse_args()
@@ -196,7 +201,8 @@ def main() -> int:
     g, bufs, metric = built[0], built[1], built[2]
     plat = Platform.make_n_lanes(2)
     ex = TraceExecutor(plat, bufs)
-    bench = CachingBenchmarker(EmpiricalBenchmarker(ex))
+    emp = EmpiricalBenchmarker(ex)
+    bench = CachingBenchmarker(emp)
     opts = BenchOpts(n_iters=max(5, args.iters), target_secs=0.002 if args.smoke else 0.02)
 
     # naive incumbent: the fully-synchronous serialization on one lane (the
@@ -215,6 +221,24 @@ def main() -> int:
     naive = bench.benchmark(naive_seq, opts)
     sys.stderr.write(f"naive: pct50={naive.pct50*1e6:.1f}us (wall {time.time()-t0:.0f}s)\n")
 
+    # anytime search: heuristic incumbents first, then the directed search.
+    # For halo the domain heuristic is the post-all-before-await-any overlap
+    # discipline — the one the reference's graph hard-codes via its
+    # every-post-before-any-wait edges (ops_halo_exchange.cu:249-256)
+    incumbents = []
+    if args.workload == "halo":
+        from tenzing_tpu.models.halo_pipeline import greedy_overlap_order
+        from tenzing_tpu.solve.mcts.mcts import SimResult
+
+        greedy_seq = greedy_overlap_order(built[3], plat)
+        t0 = time.time()
+        greedy = bench.benchmark(greedy_seq, opts)
+        sys.stderr.write(
+            f"greedy-overlap incumbent: pct50={greedy.pct50*1e6:.1f}us "
+            f"(wall {time.time()-t0:.0f}s)\n"
+        )
+        incumbents.append(SimResult(order=greedy_seq, result=greedy))
+
     # directed search over the 2-lane order x lane x kernel space
     t0 = time.time()
     res = explore(
@@ -227,21 +251,89 @@ def main() -> int:
     for i, s in enumerate(res.sims):
         sys.stderr.write(f"mcts {i}: pct50={s.result.pct50*1e6:.1f}us\n")
     sys.stderr.write(f"mcts wall {time.time()-t0:.0f}s, tree={res.tree_size}\n")
+    res.sims = incumbents + res.sims
+
+    # decorrelated final: re-measure naive and the top candidates *together*,
+    # visiting them in a fresh random order per iteration so slow system drift
+    # cannot masquerade as a schedule difference (reference batch benchmark,
+    # benchmarker.cpp:21-76).  Search-time measurements are noisy relative to
+    # the margins here, so the top 3 *distinct* schedules by pct50 advance to
+    # the final (equivalent rollouts share one cached result — don't spend the
+    # budget re-timing one program thrice).  All programs are already compiled
+    # (executor cache) — pure measurement cost.
+    from dataclasses import replace
+
+    from tenzing_tpu.core.sequence import get_equivalence
+
+    top = []
+    for s in sorted(res.sims, key=lambda s: s.result.pct50):
+        if s.result.pct50 >= naive.pct50 * 1.1 or len(top) == 3:
+            break
+        if not any(get_equivalence(s.order, t.order) for t in top):
+            top.append(s)
+    finals = []
+    if top:
+        from tenzing_tpu.bench.benchmarker import BenchResult
+        from tenzing_tpu.utils.numeric import paired_speedup
+
+        # double the measurement count for the verdict: the batch decorrelates
+        # drift, and the margin is small relative to tunnel noise
+        fin_opts = replace(opts, n_iters=2 * opts.n_iters)
+        fin_times = emp.benchmark_batch_times(
+            [naive_seq] + [s.order for s in top], fin_opts, seed=1
+        )
+        finals = [BenchResult.from_times(ts) for ts in fin_times]
+        fin_naive, fin_cands = finals[0], finals[1:]
+        sys.stderr.write(
+            "final batch: naive=%.1fus candidates=[%s]us\n"
+            % (
+                fin_naive.pct50 * 1e6,
+                ", ".join("%.1f" % (r.pct50 * 1e6) for r in fin_cands),
+            )
+        )
+        # the verdict is the *paired* per-iteration speedup: iteration k runs
+        # every schedule back-to-back, so naive[k]/cand[k] cancels the drift
+        # common to both — far tighter than comparing pct50s across the run
+        paired = [paired_speedup(fin_times[0], ts, seed=2) for ts in fin_times[1:]]
+        best_i = max(range(len(paired)), key=lambda i: paired[i][0])
+        m, lo, hi = paired[best_i]
+        sys.stderr.write(
+            "paired speedup vs naive: best=%.4f [%.4f, %.4f] 95%% CI "
+            "(all: %s)\n"
+            % (m, lo, hi, ", ".join("%.4f" % p[0] for p in paired))
+        )
+        # a win requires the bootstrap CI to exclude 1.0, not just the bare
+        # median — otherwise sampling noise reports a spurious speedup on
+        # roughly half of no-difference runs
+        if m > 1.0 and lo > 1.0:
+            value_us = fin_cands[best_i].pct50 * 1e6
+            vs = m
+        else:
+            value_us = fin_naive.pct50 * 1e6
+            vs = 1.0
+    else:
+        value_us = naive.pct50 * 1e6
+        vs = 1.0
 
     if args.dump_csv:
-        rows = [result_row(0, naive, naive_seq)] + [
-            result_row(i + 1, s.result, s.order) for i, s in enumerate(res.sims)
-        ]
+        # One row per distinct schedule.  The decorrelated final-batch results
+        # *supersede* the search-time measurements for naive and the finalists
+        # (CsvBenchmarker returns the first equivalence match, so appending
+        # duplicate rows would leave the finals unreachable) — the headline
+        # verdict is replayable from the recorded database.
+        results = [naive] + [s.result for s in res.sims]
+        if finals:
+            results[0] = finals[0]
+            for r, s in zip(finals[1:], top):
+                # identity, not ==: sync ops compare kind-only, so two distinct
+                # schedules can be ==-equal and .index() would mis-attribute
+                idx = next(i for i, s2 in enumerate(res.sims) if s2 is s)
+                results[1 + idx] = r
+        orders = [naive_seq] + [s.order for s in res.sims]
+        rows = [result_row(i, r, o) for i, (r, o) in enumerate(zip(results, orders))]
         with open(args.dump_csv, "w") as f:
             f.write("\n".join(rows) + "\n")
         sys.stderr.write(f"csv: {args.dump_csv} ({len(rows)} rows)\n")
-
-    best = min(
-        [(naive.pct50, naive)] + [(s.result.pct50, s.result) for s in res.sims],
-        key=lambda t: t[0],
-    )[1]
-    value_us = best.pct50 * 1e6
-    vs = naive.pct50 / best.pct50
     print(
         json.dumps(
             {

@@ -189,12 +189,17 @@ class EmpiricalBenchmarker:
     # reference batch benchmark(), benchmarker.cpp:21-76: measure a SET of
     # schedules, visiting them in a fresh random permutation each iteration so
     # slow system drift decorrelates from schedule identity.
-    def benchmark_batch(
+    def benchmark_batch_times(
         self,
         orders: List[Sequence],
         opts: Optional[BenchOpts] = None,
         seed: int = 0,
-    ) -> List[BenchResult]:
+    ) -> List[List[float]]:
+        """Raw per-iteration times, aligned by iteration index: ``times[i][k]``
+        is schedule i's secs-per-sample in iteration k, and iteration k visits
+        every schedule once (shuffled) — so ``times[a][k] / times[b][k]`` is a
+        *paired* comparison in which common-mode drift cancels (see
+        utils.numeric.paired_speedup)."""
         opts = opts if opts is not None else BenchOpts()
         rng = _random.Random(seed)
         runners = [self._runner_for(o) for o in orders]
@@ -209,7 +214,18 @@ class EmpiricalBenchmarker:
                 run_n, fences = runners[i]
                 t, n_samples[i] = self._measure(run_n, n_samples[i], opts, fences)
                 times[i].append(t)
-        return [BenchResult.from_times(ts) for ts in times]
+        return times
+
+    def benchmark_batch(
+        self,
+        orders: List[Sequence],
+        opts: Optional[BenchOpts] = None,
+        seed: int = 0,
+    ) -> List[BenchResult]:
+        return [
+            BenchResult.from_times(ts)
+            for ts in self.benchmark_batch_times(orders, opts, seed)
+        ]
 
 
 class CachingBenchmarker:

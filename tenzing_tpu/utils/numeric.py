@@ -72,3 +72,27 @@ def percentile(sorted_xs: Sequence[float], pct: float) -> float:
         raise ValueError("empty series")
     i = min(len(sorted_xs) - 1, max(0, int(round(pct / 100.0 * (len(sorted_xs) - 1)))))
     return sorted_xs[i]
+
+
+def paired_speedup(
+    base: Sequence[float], cand: Sequence[float], seed: int = 0, n_boot: int = 2000
+) -> tuple:
+    """(median speedup, ci_lo, ci_hi): per-iteration paired speedup base/cand
+    with a seeded bootstrap 95% CI over the iteration-aligned ratio series.
+
+    Input series must be iteration-aligned (``EmpiricalBenchmarker.
+    benchmark_batch_times``: iteration k visits every schedule once, in a
+    shuffled order) so each ratio compares measurements taken back-to-back
+    under the same system conditions — slow drift common to both schedules
+    cancels instead of inflating the verdict's variance.  Extends the
+    reference's decorrelation idea (benchmarker.cpp:21-76) from "shuffle the
+    visit order" to "compare within the iteration"."""
+    import random as _random
+
+    if len(base) != len(cand) or not base:
+        raise ValueError("paired_speedup needs two equal-length non-empty series")
+    ratios = [b / c for b, c in zip(base, cand)]
+    rng = _random.Random(seed)
+    n = len(ratios)
+    meds = sorted(med([ratios[rng.randrange(n)] for _ in range(n)]) for _ in range(n_boot))
+    return med(ratios), percentile(meds, 2.5), percentile(meds, 97.5)

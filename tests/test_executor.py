@@ -240,3 +240,19 @@ def test_caching_benchmarker_dedups_equivalent_schedules():
     r2 = bench.benchmark(st.sequence, opts)
     assert r1 is r2
     assert bench.hits == 1 and bench.misses == 1
+
+
+def test_benchmark_batch_times_iteration_aligned():
+    """benchmark_batch_times returns iteration-aligned raw series (one value
+    per schedule per iteration) — the input contract of paired_speedup."""
+    g = diamond_graph()
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, make_bufs())
+    bench = EmpiricalBenchmarker(ex)
+    orders = [s.sequence for s in get_all_sequences(g, plat, max_seqs=2)]
+    times = bench.benchmark_batch_times(
+        orders, BenchOpts(n_iters=4, target_secs=0.0005), seed=7
+    )
+    assert len(times) == len(orders)
+    assert all(len(ts) == 4 for ts in times)
+    assert all(t > 0.0 for ts in times for t in ts)

@@ -72,3 +72,25 @@ def test_runs_test_rejects_alternation():
     xs = [float(i % 2) for i in range(200)]
     assert not is_random(xs)
     assert runs_test_z(xs) > 1.96
+
+
+def test_paired_speedup_cancels_common_mode_drift():
+    """The paired per-iteration verdict: a 10% real speedup stays visible with
+    a tight CI under 50% common-mode drift that would swamp unpaired pct50s."""
+    from tenzing_tpu.utils.numeric import paired_speedup
+
+    rng = random.Random(1)
+    drift = [1 + 0.5 * abs((k % 40) - 20) / 20 for k in range(40)]
+    base = [0.10 * d * (1 + 0.02 * rng.random()) for d in drift]
+    cand = [0.09 * d * (1 + 0.02 * rng.random()) for d in drift]
+    m, lo, hi = paired_speedup(base, cand, seed=0)
+    assert 1.08 < m < 1.14
+    assert lo > 1.05 and hi < 1.15 and lo <= m <= hi
+    # deterministic under the seed
+    assert (m, lo, hi) == paired_speedup(base, cand, seed=0)
+    # no-difference case straddles 1.0
+    same = [0.1 * d for d in drift]
+    m2, lo2, hi2 = paired_speedup(same, list(same), seed=0)
+    assert m2 == 1.0 and lo2 <= 1.0 <= hi2
+    with pytest.raises(ValueError):
+        paired_speedup([1.0], [1.0, 2.0])

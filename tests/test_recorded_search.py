@@ -78,6 +78,34 @@ def test_searched_beats_naive_outside_noise(db, db_naive):
     )
 
 
+def test_round2_recording_also_replays():
+    """The round-2 full-budget recording (naive + greedy-overlap incumbent +
+    24 MCTS iterations, same config; incumbent/naive rows carry the
+    decorrelated final-batch measurements) loads and shows the same structure:
+    best candidate under naive."""
+    path = os.path.join(REPO, "experiments", "halo_search_tpu_r2.csv")
+    n_rows = sum(1 for line in open(path) if line.strip())
+    g = build_graph(ARGS, impl_choice=True)
+    db2 = CsvBenchmarker.from_file(path, g, strict=False)
+    # rows 0 (naive) and 1 (greedy incumbent) come from the pre-choice graph
+    g_plain = build_graph(ARGS, impl_choice=False)
+    db2_plain = CsvBenchmarker.from_file(path, g_plain, strict=False)
+    assert len(db2.entries) == n_rows - 2 and db2.skipped == [0, 1]
+    assert len(db2_plain.entries) == 2
+    naive = db2_plain.entries[0][1]
+    cands = [db2_plain.entries[1][1]] + [r for _, r in db2.entries]
+    assert min(r.pct50 for r in cands) < naive.pct50
+
+    # and the postprocess analyzer handles the full-budget recording too
+    import io
+
+    from postprocess.postprocess import analyze
+
+    with open(path) as f:
+        out = analyze(f.read(), stream=io.StringIO())
+    assert out["n"] == n_rows
+
+
 def test_postprocess_on_real_recorded_data():
     """Class-boundary + decision-tree analysis runs on the real CSV and finds
     the searched-fast vs naive-slow structure."""
