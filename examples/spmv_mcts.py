@@ -21,6 +21,9 @@ def main() -> int:
     ap.add_argument("--matrix-m", type=int, default=150_000,
                     help="rows (reference spmv_run_strategy.cuh:44)")
     ap.add_argument("--nnz-per-row", type=int, default=10)
+    ap.add_argument("--matrix", default=None,
+                    help="MatrixMarket .mtx input instead of the random band "
+                         "matrix (reference spmv.cu:35-37)")
     args = ap.parse_args()
     _driver.setup(args)
 
@@ -29,11 +32,17 @@ def main() -> int:
     from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
     from tenzing_tpu.core.graph import Graph
     from tenzing_tpu.core.platform import Platform
-    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+    from tenzing_tpu.models.spmv import (
+        SpMVCompound,
+        make_spmv_buffers,
+        read_matrix_market,
+    )
     from tenzing_tpu.runtime.executor import TraceExecutor
     from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
 
-    bufs, _ = make_spmv_buffers(m=args.matrix_m, nnz_per_row=args.nnz_per_row, seed=args.seed)
+    mat = read_matrix_market(args.matrix) if args.matrix else None
+    bufs, _ = make_spmv_buffers(m=args.matrix_m, nnz_per_row=args.nnz_per_row,
+                                seed=args.seed, matrix=mat)
     bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
     g = Graph()
     g.start_then(SpMVCompound())

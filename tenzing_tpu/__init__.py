@@ -5,16 +5,20 @@ operations.  Remaining implementation freedom — the total order of operations,
 assignment of device ops to execution *lanes*, the insertion of synchronization ops
 that make a given order legal, and choices among implementation variants — is a
 sequential decision problem searched by exhaustive DFS (`tenzing_tpu.solve.dfs`) and
-Monte-Carlo tree search (`tenzing_tpu.solve.mcts`, in progress).  Every candidate schedule is
+Monte-Carlo tree search (`tenzing_tpu.solve.mcts`).  Every candidate schedule is
 lowered to a single XLA program whose dependency structure *is* the schedule
 (token-threaded lanes, see `tenzing_tpu.runtime.executor`) and empirically
 benchmarked on the device.
 
 Capability parity target: sandialabs/tenzing (see SURVEY.md).  This is a new
 TPU-first design, not a port: CUDA streams -> virtual lanes realized as
-optimization-barrier token chains inside one compiled XLA program; cudaEvent ->
-cross-lane token edges; MPI Isend/Irecv -> ICI collectives (`lax.ppermute`) under
-`shard_map`; MPI control plane -> host-side process coordination.
+value-preserving scalar data-tie chains inside one compiled XLA program (the
+TPU backend strips `optimization_barrier`, so ties are real data dependencies);
+cudaEvent -> cross-lane token edges; MPI Isend/Irecv -> async post/wait ICI
+transfers (`tenzing_tpu.ops.comm_ops`) under `shard_map`; MPI control plane ->
+host-side process coordination (`tenzing_tpu.parallel.control_plane`).
+
+See docs/GUIDE.md for the user guide and the reference->TPU migration map.
 """
 
 __version__ = "0.1.0"

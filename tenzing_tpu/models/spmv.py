@@ -143,6 +143,58 @@ def random_matrix(m: int, n: int, nnz: int, seed: int = 0, dtype=np.float32) -> 
     return CooMat(m, n, rows, cols, vals).to_csr()
 
 
+def read_matrix_market(path: str, dtype=np.float32) -> CsrMat:
+    """Load a MatrixMarket coordinate file (the reference reads .mtx inputs via
+    the vendored ``mm`` reader, tenzing-dfs/examples/spmv.cu:23,35-37).
+
+    Supports ``coordinate`` matrices with field real/integer/pattern and
+    symmetry general/symmetric/skew-symmetric (off-diagonal entries mirrored,
+    skew negated).  Indices in the file are 1-based per the format."""
+    with open(path) as f:
+        header = f.readline().split()
+        if (
+            len(header) < 5
+            or header[0] != "%%MatrixMarket"
+            or header[1].lower() != "matrix"
+            or header[2].lower() != "coordinate"
+        ):
+            raise ValueError(f"{path}: not a MatrixMarket coordinate file: {header}")
+        field, symmetry = header[3].lower(), header[4].lower()
+        if field not in ("real", "integer", "pattern"):
+            raise ValueError(f"{path}: unsupported field {field!r}")
+        if symmetry not in ("general", "symmetric", "skew-symmetric"):
+            raise ValueError(f"{path}: unsupported symmetry {symmetry!r}")
+        line = f.readline()
+        while line and (line.lstrip().startswith("%") or not line.strip()):
+            line = f.readline()
+        if not line:
+            raise ValueError(f"{path}: truncated file (no size line)")
+        m, n, nnz = (int(t) for t in line.split())
+        rows = np.empty(nnz, dtype=np.int64)
+        cols = np.empty(nnz, dtype=np.int64)
+        vals = np.ones(nnz, dtype=dtype)
+        k = 0
+        for line in f:
+            t = line.split()
+            if not t or t[0].startswith("%"):
+                continue
+            rows[k], cols[k] = int(t[0]) - 1, int(t[1]) - 1
+            if field != "pattern":
+                vals[k] = float(t[2])
+            k += 1
+        if k != nnz:
+            raise ValueError(f"{path}: header promised {nnz} entries, found {k}")
+    if symmetry != "general":
+        off = rows != cols
+        sign = -1.0 if symmetry == "skew-symmetric" else 1.0
+        rows, cols, vals = (
+            np.concatenate([rows, cols[off]]),
+            np.concatenate([cols, rows[off]]),
+            np.concatenate([vals, (sign * vals[off]).astype(dtype)]),
+        )
+    return CooMat(m, n, rows, cols, vals).to_csr()
+
+
 # -- partition helpers (reference partition.hpp:11-75) ---------------------------
 
 
@@ -385,13 +437,21 @@ def make_spmv_buffers(
     bw: Optional[int] = None,
     seed: int = 0,
     slab_width: Optional[int] = None,
+    matrix: Optional[CsrMat] = None,
 ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
     """Build the buffer dict for the single-device SpMV slice and the dense
     reference answer.  The matrix is split at the column midpoint to mimic the
     distributed local/remote structure (reference spmv_run_strategy.cuh:44-47
-    config: m rows, nnz=10*m, band bw)."""
-    bw = bw if bw is not None else max(1, m // 8)
-    a = random_band_matrix(m, bw, nnz_per_row * m, seed=seed)
+    config: m rows, nnz=10*m, band bw).  Pass ``matrix`` (e.g. from
+    ``read_matrix_market``) to benchmark a concrete input instead of the random
+    band matrix, matching the reference's .mtx path (spmv.cu:35-37)."""
+    if matrix is not None:
+        if matrix.m != matrix.n:
+            raise ValueError(f"SpMV slice needs a square matrix, got {matrix.m}x{matrix.n}")
+        a, m = matrix, matrix.m
+    else:
+        bw = bw if bw is not None else max(1, m // 8)
+        a = random_band_matrix(m, bw, nnz_per_row * m, seed=seed)
     half = m // 2
     sp = split_local_remote(a, 0, half)
     lv, lc = sp.local.to_slab(slab_width)
