@@ -181,3 +181,29 @@ def test_pipeline_benchmarkable_smoke():
         naive_order(ARGS, ex.platform), BenchOpts(n_iters=3, target_secs=0.0005)
     )
     assert res.pct50 > 0.0
+
+
+def test_greedy_overlap_order_legal_disciplined_and_correct():
+    """The greedy incumbent (bench.py's anytime seed): every prefix passes the
+    sync oracle, every transfer is posted before any await (the discipline the
+    reference graph hard-codes, ops_halo_exchange.cu:249-256), packs alternate
+    lanes, and the result is numerically right."""
+    from tenzing_tpu.core.event_synchronizer import EventSynchronizer
+    from tenzing_tpu.core.sequence import Sequence
+    from tenzing_tpu.models.halo_pipeline import greedy_overlap_order
+
+    plat = Platform.make_n_lanes(2)
+    order = greedy_overlap_order(ARGS, plat)
+    g = build_graph(ARGS)
+    ops = order.vector()
+    for i, op in enumerate(ops):
+        assert EventSynchronizer.is_synced(g, Sequence(ops[:i]), op), op.desc()
+    names = [op.desc() for op in ops]
+    first_await = min(i for i, n in enumerate(names) if n.startswith("await"))
+    last_post = max(i for i, n in enumerate(names) if n.startswith(("spill", "fetch")))
+    assert last_post < first_await
+    lanes = {n.split("@")[1] for n in names if n.startswith("pack") and "@" in n}
+    assert len(lanes) == 2
+    ex, want = _executor()
+    out = ex.run(order)
+    np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
