@@ -75,15 +75,13 @@ def metric_for(workload: str, args) -> str:
 
 
 def build_halo(args):
-    import jax
-    import jax.numpy as jnp
-
     from tenzing_tpu.models.halo import HaloArgs
     from tenzing_tpu.models.halo_pipeline import (
         build_graph,
         host_buffer_names,
         make_pipeline_buffers,
     )
+    from tenzing_tpu.runtime.executor import TraceExecutor
 
     if args.smoke:
         hargs = HaloArgs(nq=2, lx=4, ly=4, lz=4, radius=1)
@@ -91,15 +89,7 @@ def build_halo(args):
         n = args.halo_n
         hargs = HaloArgs(nq=3, lx=n, ly=n, lz=n, radius=3)
     bufs, _ = make_pipeline_buffers(hargs, seed=0, with_expected=False)
-    host_sh = jax.sharding.SingleDeviceSharding(
-        jax.devices()[0], memory_kind="pinned_host"
-    )
-    jbufs = {}
-    for k, v in bufs.items():
-        if k in host_buffer_names():
-            jbufs[k] = jax.device_put(jnp.asarray(v), host_sh)
-        else:
-            jbufs[k] = jnp.asarray(v)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
     # kernel menu only where a real TPU compiles it; interpret-mode Pallas
     # would dominate a CPU smoke timing
     impl_choice = not args.smoke
@@ -108,23 +98,30 @@ def build_halo(args):
 
 
 def build_spmv(args):
-    import jax.numpy as jnp
-
     from tenzing_tpu.core.graph import Graph
-    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+    from tenzing_tpu.models.spmv import (
+        SpMVCompound,
+        make_spmv_buffers,
+        spmv_host_buffer_names,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
 
     m = args.m if args.m is not None else (512 if args.smoke else 150_000)
     bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, seed=0)
-    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    jbufs = TraceExecutor.place_host_buffers(bufs, spmv_host_buffer_names())
     # impl_choice: the kernel menu (XLA gather vs Pallas vreg-gather) is part
     # of the searched space alongside order and lane assignment; known x sizes
-    # prune Pallas choices that would only alias the XLA path (ADVICE r1)
-    x_sizes = {"x_local": int(bufs["x_local"].shape[0]),
-               "x_remote": int(bufs["x_remote"].shape[0])}
+    # prune Pallas choices that would only alias the XLA path (ADVICE r1).
+    # exchange="host": the x exchange is a posted async host round-trip DMA
+    # (the reference's MPI hop), so the post/wait split gives the search a
+    # real transfer to hide behind the local SpMV
+    x_sizes = {"x_local": int(jbufs["x_local"].shape[0]),
+               "x_remote": int(jbufs["x_remote"].shape[0])}
+    mk = lambda: SpMVCompound(impl_choice=True, x_sizes=x_sizes, exchange="host")
     g = Graph()
-    g.start_then(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
-    g.then_finish(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
-    return g, bufs, metric_for("spmv", args)
+    g.start_then(mk())
+    g.then_finish(mk())
+    return g, jbufs, metric_for("spmv", args)
 
 
 def build_attn(args):

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Replay-driven MCTS: search a *recorded* result database instead of a device.
+
+Parity target: the reference's CSV-replay drivers
+(``tenzing-mcts/examples/mcts_csv_*.cu``, built around CsvBenchmarker,
+benchmarker.cpp:169-223) — search-algorithm experiments with no machine in the
+loop.  Each strategy runs MCTS against the recorded timings; the report shows
+how quickly each one finds the database's best schedule, the reference's
+search-quality signal (SURVEY.md §6: MCTS-found min vs the recorded
+distribution).
+
+Best with a database covering the whole search space (a full deduplicated DFS
+dump — ``examples/spmv_dfs.py --max-seqs`` at least the space size); rollouts
+are matched modulo redundant-sync cleanup (CsvBenchmarker ``normalize=True``).
+A rollout landing on an unrecorded schedule scores as the database's worst
+result (pessimistic prior); the report counts these misses so a capped dump
+still yields an honest — if coarser — comparison.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples import _driver
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    _driver.add_common_args(ap)
+    ap.add_argument("--csv", required=True, help="recorded result database")
+    ap.add_argument("--mcts-iters", type=int, default=64)
+    ap.add_argument("--strategies", default="Random,FastMin,Coverage,AntiCorrelation",
+                    help="comma-separated strategy names to compare")
+    args = ap.parse_args()
+    _driver.setup(args)
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, CsvBenchmarker
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.spmv import SpMVCompound
+    from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
+
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    db = CsvBenchmarker.from_file(args.csv, g, normalize=True)
+    recorded_best = min(r.pct50 for _, r in db.entries)
+    sys.stderr.write(
+        f"database: {len(db.entries)} schedules, best pct50 "
+        f"{recorded_best*1e6:.1f}us\n"
+    )
+
+    class _PessimisticReplay:
+        """Unrecorded rollouts score as the worst recorded result."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.worst = max((r for _, r in inner.entries), key=lambda r: r.pct50)
+            self.misses = 0
+
+        def benchmark(self, order, opts=None):
+            try:
+                return self.inner.benchmark(order, opts)
+            except KeyError:
+                self.misses += 1
+                return self.worst
+
+    plat = Platform.make_n_lanes(args.lanes)
+    for name in args.strategies.split(","):
+        strat = getattr(strategies, name)
+        replay = _PessimisticReplay(db)
+        res = explore(
+            g, plat, replay,
+            MctsOpts(n_iters=args.mcts_iters, bench_opts=BenchOpts(),
+                     seed=args.seed),
+            strategy=strat,
+        )
+        # iterations-to-best: the search-quality signal
+        best_so_far, hit_at = float("inf"), None
+        for i, s in enumerate(res.sims):
+            if s.result.pct50 < best_so_far:
+                best_so_far = s.result.pct50
+            if hit_at is None and best_so_far <= recorded_best:
+                hit_at = i
+        miss = f", {replay.misses} unrecorded rollouts" if replay.misses else ""
+        print(
+            f"{name}: best {best_so_far*1e6:.1f}us over {len(res.sims)} "
+            f"benchmarked rollouts{miss}; recorded optimum "
+            f"{'hit at iter %d' % hit_at if hit_at is not None else 'not reached'}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

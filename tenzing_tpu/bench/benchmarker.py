@@ -298,13 +298,26 @@ class CsvBenchmarker:
     ``strict=False`` skips rows whose ops cannot be resolved against ``graph``
     (recorded against a different structural variant — e.g. a naive baseline
     dumped from the pre-choice graph); skipped row indices are kept in
-    ``self.skipped`` so callers can see what the database did not cover."""
+    ``self.skipped`` so callers can see what the database did not cover.
 
-    def __init__(self, rows: List[str], graph, strict: bool = True):
+    ``normalize=True`` matches queries modulo ``remove_redundant_syncs`` (both
+    sides cleaned before the bijection check).  The peephole rules only delete
+    sync ops with no execution effect, so normalized-equal schedules are the
+    same program — this lets a database recorded by the DFS solver (raw
+    terminal sequences) answer queries from the MCTS solver (which cleans
+    every rollout before benchmarking), the offline replay-search workflow of
+    the reference's mcts_csv drivers."""
+
+    def __init__(self, rows: List[str], graph, strict: bool = True,
+                 normalize: bool = False):
         from tenzing_tpu.core.serdes import op_from_json
         import json
 
+        from tenzing_tpu.core.schedule import remove_redundant_syncs
+
+        self._normalize = remove_redundant_syncs if normalize else (lambda s: s)
         self.entries: List[Tuple[Sequence, BenchResult]] = []
+        self._keys: List[Sequence] = []  # normalized match keys, 1:1 with entries
         self.skipped: List[int] = []
         for i, row in enumerate(rows):
             if not row.strip():
@@ -327,16 +340,21 @@ class CsvBenchmarker:
                     raise
                 self.skipped.append(i)
                 continue
-            self.entries.append((Sequence(ops), res))
+            seq = Sequence(ops)
+            self.entries.append((seq, res))
+            self._keys.append(self._normalize(seq))
 
     @classmethod
-    def from_file(cls, path: str, graph, strict: bool = True) -> "CsvBenchmarker":
+    def from_file(cls, path: str, graph, strict: bool = True,
+                  normalize: bool = False) -> "CsvBenchmarker":
         with open(path) as f:
-            return cls(f.read().splitlines(), graph, strict=strict)
+            return cls(f.read().splitlines(), graph, strict=strict,
+                       normalize=normalize)
 
     def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
-        for stored, res in self.entries:
-            if get_equivalence(stored, order):
+        query = self._normalize(order)
+        for key, (_, res) in zip(self._keys, self.entries):
+            if get_equivalence(key, query):
                 return res
         raise KeyError(
             f"no recorded schedule equivalent to: {order.desc()}"

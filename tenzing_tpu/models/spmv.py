@@ -399,14 +399,30 @@ class SpMVCompound(CompoundOp):
 
     With ``impl_choice=True`` the two SpMV kernels become implementation
     ChoiceOps (XLA gather vs Pallas vreg-gather) and the solver searches the
-    kernel menu alongside order and lane assignment."""
+    kernel menu alongside order and lane assignment.
+
+    ``exchange`` picks the single-chip stand-in for the reference's MPI x
+    exchange (PostSend/WaitRecv ops, ops_spmv.cuh:217-304):
+
+    * ``"local"`` (default) — a device-to-device copy.  All-compute DAG: on a
+      TPU core, compute ops cannot overlap across lanes, so schedule order
+      barely matters (measured: paired speedup CI straddles 1.0).
+    * ``"host"`` — an async host round-trip DMA with the post/wait split
+      (spill -> fetch -> await), the same substrate as the halo pipeline.
+      This is the faithful analog of the reference's network hop: the search
+      can hide the transfer behind the local SpMV, and the naive
+      serialization pays it in full."""
 
     def __init__(self, name: str = "spmv", impl_choice: bool = False,
-                 x_sizes: Optional[Dict[str, int]] = None):
+                 x_sizes: Optional[Dict[str, int]] = None,
+                 exchange: str = "local"):
         super().__init__(name)
         self._impl_choice = impl_choice
         # buffer-name -> x length, when known (prunes unsupported Pallas choices)
         self._x_sizes = dict(x_sizes) if x_sizes else {}
+        if exchange not in ("local", "host"):
+            raise ValueError(f"exchange must be 'local' or 'host', got {exchange!r}")
+        self._exchange = exchange
 
     def graph(self) -> Graph:
         g = Graph()
@@ -418,13 +434,28 @@ class SpMVCompound(CompoundOp):
             mk = SpMVOp
         yl = mk("spmv_local", "x_local", "y_local", "A_loc_vals", "A_loc_cols")
         scatter = Scatter("scatter", "x_local", "send_idx", "send_buf")
-        exch = LocalExchange("exchange", "send_buf", "x_remote")
         yr = mk("spmv_remote", "x_remote", "y_remote", "A_rem_vals", "A_rem_cols")
         add = VectorAdd("y_add", "y_local", "y_remote", "y")
         g.start_then(yl)
         g.start_then(scatter)
-        g.then(scatter, exch)
-        g.then(exch, yr)
+        if self._exchange == "host":
+            from tenzing_tpu.ops.comm_ops import (
+                AwaitTransfer,
+                HostFetchStart,
+                HostSpillStart,
+            )
+
+            spill = HostSpillStart("spill_x", "send_buf", "host_x")
+            fetch = HostFetchStart("fetch_x", "host_x", "x_remote")
+            await_ = AwaitTransfer("await_x", "x_remote")
+            g.then(scatter, spill)
+            g.then(spill, fetch)
+            g.then(fetch, await_)
+            g.then(await_, yr)
+        else:
+            exch = LocalExchange("exchange", "send_buf", "x_remote")
+            g.then(scatter, exch)
+            g.then(exch, yr)
         g.then(yl, add)
         g.then(yr, add)
         g.then_finish(add)
@@ -470,6 +501,9 @@ def make_spmv_buffers(
         "A_rem_cols": rc,
         "send_idx": send_idx,
         "send_buf": np.zeros(len(send_idx), dtype=np.float32),
+        # staging buffer for the exchange="host" round trip (place in
+        # pinned_host, see spmv_host_buffer_names); unused by exchange="local"
+        "host_x": np.zeros(len(send_idx), dtype=np.float32),
         "x_remote": np.zeros(len(send_idx), dtype=np.float32),
         "y_local": np.zeros(m, dtype=np.float32),
         "y_remote": np.zeros(m, dtype=np.float32),
@@ -477,3 +511,9 @@ def make_spmv_buffers(
     }
     want = a.matvec(x)
     return bufs, want
+
+
+def spmv_host_buffer_names() -> List[str]:
+    """Buffers to device_put into pinned_host for ``exchange="host"`` (the
+    executor detects host residency from the array's sharding memory kind)."""
+    return ["host_x"]

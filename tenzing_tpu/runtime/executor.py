@@ -219,6 +219,25 @@ class TraceExecutor:
         self.init_bufs = dict(init_bufs)
         self._cache: Dict[str, Callable] = {}
 
+    @staticmethod
+    def place_host_buffers(bufs: Dict[str, Any], host_names) -> Dict[str, Any]:
+        """jnp arrays for ``bufs`` with ``host_names`` device_put into
+        pinned_host — the placement `_initial_host_space` detects (single
+        shared helper for every workload's host-staged buffers)."""
+        import jax
+        import jax.numpy as jnp
+
+        host_sh = jax.sharding.SingleDeviceSharding(
+            jax.devices()[0], memory_kind="pinned_host"
+        )
+        host_names = set(host_names)
+        return {
+            k: jax.device_put(jnp.asarray(v), host_sh)
+            if k in host_names
+            else jnp.asarray(v)
+            for k, v in bufs.items()
+        }
+
     # -- build -------------------------------------------------------------
     def _initial_host_space(self) -> set:
         """Buffer names whose initial arrays live in host memory."""

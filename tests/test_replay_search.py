@@ -1,0 +1,78 @@
+"""Replay-driven search: MCTS over a recorded database with no device in the
+benchmark loop (the reference's mcts_csv driver workflow, CsvBenchmarker
+benchmarker.cpp:169-223).
+
+The DFS solver records raw terminal sequences; MCTS cleans every rollout with
+``remove_redundant_syncs`` before benchmarking — ``normalize=True`` bridges
+the two by matching modulo the cleanup (identical execution semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tenzing_tpu.bench.benchmarker import (
+    BenchOpts,
+    CsvBenchmarker,
+    EmpiricalBenchmarker,
+    result_row,
+)
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+from tenzing_tpu.runtime.executor import TraceExecutor
+from tenzing_tpu.solve.dfs import enumerate_schedules
+from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
+
+
+def _graph():
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    return g
+
+
+@pytest.fixture(scope="module")
+def recorded_db():
+    """Benchmark the FULL deduplicated 2-lane space of the tiny SpMV DAG on
+    CPU and dump it, as examples/spmv_dfs.py would."""
+    plat = Platform.make_n_lanes(2)
+    states = enumerate_schedules(_graph(), plat, max_seqs=10_000)
+    assert len(states) < 10_000  # complete coverage, not a capped subset
+    bufs, _ = make_spmv_buffers(m=64, nnz_per_row=3, seed=0)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    bench = EmpiricalBenchmarker(TraceExecutor(plat, bufs))
+    opts = BenchOpts(n_iters=2, target_secs=1e-4)
+    rows = [
+        result_row(i, bench.benchmark(st.sequence, opts), st.sequence)
+        for i, st in enumerate(states)
+    ]
+    return CsvBenchmarker(rows, _graph(), normalize=True), len(states)
+
+
+def test_mcts_replays_recorded_database_without_device(recorded_db):
+    """Every MCTS rollout must resolve against the recorded full space —
+    KeyError here would mean the replay bridge (normalize) is broken."""
+    db, n = recorded_db
+    assert len(db.entries) == n
+    plat = Platform.make_n_lanes(2)
+    res = explore(
+        _graph(),
+        plat,
+        db,
+        MctsOpts(n_iters=12, bench_opts=BenchOpts(), seed=3),
+        strategy=strategies.FastMin,
+    )
+    assert res.sims
+    best = min(s.result.pct50 for s in res.sims)
+    recorded_best = min(r.pct50 for _, r in db.entries)
+    assert best >= recorded_best  # replay cannot invent a faster schedule
+
+
+def test_normalize_matches_cleaned_query(recorded_db):
+    """A raw recorded sequence and its cleaned form answer identically."""
+    from tenzing_tpu.core.schedule import remove_redundant_syncs
+
+    db, _ = recorded_db
+    raw, res = db.entries[0]
+    assert db.benchmark(raw).pct50 == res.pct50
+    assert db.benchmark(remove_redundant_syncs(raw)).pct50 == res.pct50
