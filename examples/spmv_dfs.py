@@ -23,6 +23,10 @@ def main() -> int:
     ap.add_argument("--matrix", default=None,
                     help="MatrixMarket .mtx input instead of the random band "
                          "matrix (reference spmv.cu:35-37)")
+    ap.add_argument("--batch", action="store_true",
+                    help="decorrelated batch benchmarking: every schedule "
+                         "visited once per iteration in random order "
+                         "(reference benchmarker.cpp:21-76)")
     args = ap.parse_args()
     _driver.setup(args)
 
@@ -50,7 +54,9 @@ def main() -> int:
     bench = EmpiricalBenchmarker(TraceExecutor(plat, bufs))
     res = explore(
         g, plat, bench,
-        DfsOpts(max_seqs=args.max_seqs, bench_opts=BenchOpts(n_iters=args.benchmark_iters)),
+        DfsOpts(max_seqs=args.max_seqs,
+                bench_opts=BenchOpts(n_iters=args.benchmark_iters),
+                batch=args.batch, batch_seed=args.seed),
     )
     _driver.emit(res, args.dump_csv)
     return 0

@@ -194,19 +194,28 @@ class EmpiricalBenchmarker:
         orders: List[Sequence],
         opts: Optional[BenchOpts] = None,
         seed: int = 0,
+        times_out: Optional[List[List[float]]] = None,
     ) -> List[List[float]]:
         """Raw per-iteration times, aligned by iteration index: ``times[i][k]``
         is schedule i's secs-per-sample in iteration k, and iteration k visits
         every schedule once (shuffled) — so ``times[a][k] / times[b][k]`` is a
         *paired* comparison in which common-mode drift cancels (see
-        utils.numeric.paired_speedup)."""
+        utils.numeric.paired_speedup).
+
+        ``times_out`` (a list of ``len(orders)`` empty lists) is filled in
+        place as measurements land, so a signal handler can snapshot partial
+        data from a long batch (the DFS partial-dump contract, trap.py)."""
         opts = opts if opts is not None else BenchOpts()
         rng = _random.Random(seed)
         runners = [self._runner_for(o) for o in orders]
         for r, _ in runners:
             r(1)  # warmup/compile all before timing any
         n_samples = [1] * len(orders)
-        times: List[List[float]] = [[] for _ in orders]
+        if times_out is not None and len(times_out) != len(orders):
+            raise ValueError("times_out must have one (empty) list per order")
+        times: List[List[float]] = (
+            times_out if times_out is not None else [[] for _ in orders]
+        )
         for _ in range(opts.n_iters):
             perm = list(range(len(orders)))
             rng.shuffle(perm)  # seeded: identical visit order on every host

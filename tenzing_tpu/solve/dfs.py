@@ -26,14 +26,27 @@ from tenzing_tpu.utils import trap
 
 @dataclass
 class DfsOpts:
-    """reference dfs::Opts (dfs.hpp:30-40; maxSeqs cap from examples/spmv.cu:117)."""
+    """reference dfs::Opts (dfs.hpp:30-40; maxSeqs cap from examples/spmv.cu:117).
+
+    ``batch=True`` benchmarks the whole enumerated set through
+    ``benchmark_batch`` — every schedule visited once per iteration in a fresh
+    random order (reference batch benchmark, benchmarker.cpp:21-76) — so slow
+    system drift decorrelates from schedule identity and cross-schedule
+    comparisons in the dumped database are honest.  Falls back to one-at-a-time
+    benchmarking when the benchmarker has no ``benchmark_batch`` (e.g. CSV
+    replay) or under a multi-host control plane (the batch path is
+    single-host)."""
 
     max_seqs: int = 15000
     bench_opts: BenchOpts = field(default_factory=BenchOpts)
     dump_csv_path: Optional[str] = None
+    batch: bool = False
+    batch_seed: int = 0
 
     def to_json(self) -> dict:
-        return {"max_seqs": self.max_seqs, "n_iters": self.bench_opts.n_iters}
+        """Provenance stamp of the options (reference dfs.cpp:11-14)."""
+        return {"max_seqs": self.max_seqs, "n_iters": self.bench_opts.n_iters,
+                "batch": self.batch, "batch_seed": self.batch_seed}
 
 
 @dataclass
@@ -196,8 +209,18 @@ def explore(
     opts = opts if opts is not None else DfsOpts()
     cp = control_plane if control_plane is not None else default_control_plane()
     result = DfsResult()
+    batch_partial: dict = {}  # orders + in-flight times for mid-batch dumps
 
     def dump_partial():  # reference dfs.hpp:118-122
+        if not result.sims and batch_partial:
+            # signal arrived mid-batch: synthesize results from the times
+            # accumulated so far (benchmark_batch_times fills times_out in
+            # place) so a wall-clock-limited batch run still emits data
+            for order, ts in zip(batch_partial["orders"], batch_partial["times"]):
+                if ts:
+                    result.sims.append(
+                        SimResult(order=order, result=BenchResult.from_times(ts))
+                    )
         if opts.dump_csv_path:
             result.dump_csv(opts.dump_csv_path)
         else:
@@ -211,19 +234,46 @@ def explore(
         else:
             states, n = [], 0
         n = cp.bcast_json(n)  # stop-flag protocol (dfs.hpp:50-70)
-        for i in range(n):
-            if cp.rank() == 0:
-                st = states[i]
-                payload = sequence_to_json(st.sequence)
-            else:
-                st, payload = None, None
-            payload = cp.bcast_json(payload)
-            if cp.rank() == 0:
-                order = st.sequence
-            else:
-                order = sequence_from_json(payload, graph)
-            res = benchmarker.benchmark(order, opts.bench_opts)
-            result.sims.append(SimResult(order=order, result=res))
+        batch_times_fn = getattr(benchmarker, "benchmark_batch_times", None)
+        if opts.batch and (batch_times_fn is None or cp.size() != 1):
+            import sys
+
+            why = (
+                "multi-host control plane"
+                if cp.size() != 1
+                else f"{type(benchmarker).__name__} has no benchmark_batch_times"
+            )
+            print(
+                f"tenzing-tpu: dfs batch=True ignored ({why}); falling back to "
+                "one-at-a-time (correlated) benchmarking",
+                file=sys.stderr,
+            )
+        if opts.batch and batch_times_fn is not None and cp.size() == 1:
+            orders = [st.sequence for st in states]
+            times: List[List[float]] = [[] for _ in orders]
+            batch_partial.update(orders=orders, times=times)
+            batch_times_fn(
+                orders, opts.bench_opts, seed=opts.batch_seed, times_out=times
+            )
+            batch_partial.clear()
+            for order, ts in zip(orders, times):
+                result.sims.append(
+                    SimResult(order=order, result=BenchResult.from_times(ts))
+                )
+        else:
+            for i in range(n):
+                if cp.rank() == 0:
+                    st = states[i]
+                    payload = sequence_to_json(st.sequence)
+                else:
+                    st, payload = None, None
+                payload = cp.bcast_json(payload)
+                if cp.rank() == 0:
+                    order = st.sequence
+                else:
+                    order = sequence_from_json(payload, graph)
+                res = benchmarker.benchmark(order, opts.bench_opts)
+                result.sims.append(SimResult(order=order, result=res))
         if opts.dump_csv_path and cp.rank() == 0:
             result.dump_csv(opts.dump_csv_path)
         return result

@@ -135,3 +135,61 @@ def test_dfs_csv_dump_reloads(tmp_path):
     res = explore(g, FakePlatform(1), bench, DfsOpts(dump_csv_path=path))
     db = CsvBenchmarker.from_file(path, g)
     assert db.benchmark(res.sims[0].order).pct50 == res.sims[0].result.pct50
+
+
+def test_explore_batch_mode_decorrelated():
+    """DfsOpts(batch=True) benchmarks the whole enumerated set through
+    benchmark_batch_times (reference benchmarker.cpp:21-76) — the one-at-a-time
+    benchmark() path must NOT run, and the raw series must be iteration-aligned
+    (one measurement per schedule per iteration)."""
+    import jax.numpy as jnp
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.dfs import DfsOpts, explore
+
+    bufs, _ = make_spmv_buffers(m=32, nnz_per_row=2, seed=0)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    plat = Platform.make_n_lanes(2)
+
+    calls = {"batch": 0, "single": 0}
+
+    class Counting(EmpiricalBenchmarker):
+        def benchmark_batch_times(self, orders, opts=None, seed=0, times_out=None):
+            calls["batch"] += 1
+            calls["seed"] = seed
+            return super().benchmark_batch_times(orders, opts, seed, times_out)
+
+        def benchmark(self, order, opts=None):
+            calls["single"] += 1
+            return super().benchmark(order, opts)
+
+    bench = Counting(TraceExecutor(plat, bufs))
+    res = explore(
+        g, plat, bench,
+        DfsOpts(max_seqs=5, bench_opts=BenchOpts(n_iters=2, target_secs=1e-4),
+                batch=True, batch_seed=7),
+    )
+    assert calls == {"batch": 1, "single": 0, "seed": 7}
+    assert len(res.sims) == 5
+    assert all(s.result.pct50 > 0 for s in res.sims)
+
+
+def test_explore_batch_falls_back_without_batch_api(capsys):
+    """batch=True with a benchmarker lacking benchmark_batch_times must warn
+    on stderr and still produce results via the one-at-a-time path."""
+    g = Graph()
+    a, b = NoOp("a"), NoOp("b")
+    g.start_then(a)
+    g.start_then(b)
+    g.then_finish(a)
+    g.then_finish(b)
+    bench = CountingBenchmarker()
+    res = explore(g, FakePlatform(1), bench, DfsOpts(max_seqs=10, batch=True))
+    assert len(res.sims) == 2 and bench.calls == 2
+    assert "batch=True ignored" in capsys.readouterr().err

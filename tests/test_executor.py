@@ -256,3 +256,21 @@ def test_benchmark_batch_times_iteration_aligned():
     assert len(times) == len(orders)
     assert all(len(ts) == 4 for ts in times)
     assert all(t > 0.0 for ts in times for t in ts)
+
+
+def test_benchmark_batch_times_fills_times_out_in_place():
+    """times_out is the mid-flight accumulator a signal handler snapshots for
+    partial dumps (solve/dfs.py batch path)."""
+    g = diamond_graph()
+    plat = Platform.make_n_lanes(1)
+    ex = TraceExecutor(plat, make_bufs())
+    bench = EmpiricalBenchmarker(ex)
+    orders = [s.sequence for s in get_all_sequences(g, plat, max_seqs=2)]
+    acc = [[] for _ in orders]
+    out = bench.benchmark_batch_times(
+        orders, BenchOpts(n_iters=3, target_secs=1e-4), seed=0, times_out=acc
+    )
+    assert out is acc
+    assert all(len(ts) == 3 for ts in acc)
+    with pytest.raises(ValueError):
+        bench.benchmark_batch_times(orders, BenchOpts(n_iters=1), times_out=[[]])
