@@ -207,12 +207,17 @@ class EmpiricalBenchmarker:
         data from a long batch (the DFS partial-dump contract, trap.py)."""
         opts = opts if opts is not None else BenchOpts()
         rng = _random.Random(seed)
+        # validate before the (expensive) compile-all warmup; non-empty inner
+        # lists would shift iteration indices and silently break the paired
+        # -comparison alignment
+        if times_out is not None and (
+            len(times_out) != len(orders) or any(ts for ts in times_out)
+        ):
+            raise ValueError("times_out must have one EMPTY list per order")
         runners = [self._runner_for(o) for o in orders]
         for r, _ in runners:
             r(1)  # warmup/compile all before timing any
         n_samples = [1] * len(orders)
-        if times_out is not None and len(times_out) != len(orders):
-            raise ValueError("times_out must have one (empty) list per order")
         times: List[List[float]] = (
             times_out if times_out is not None else [[] for _ in orders]
         )

@@ -1,0 +1,319 @@
+"""Mixture-of-Experts layer: expert parallelism as a searchable op DAG.
+
+The reference has no ML layers (SURVEY.md §2.5: TP/PP/EP absent; the op-DAG
+must nonetheless *express* such programs).  This model is the expert-parallel
+(EP) member of that family, the structural sibling of the irregular SpMV
+exchange (models/spmv_irregular.py): tokens are routed to experts that live on
+other shards, so the layer is dispatch (all-to-all) -> expert FFN -> combine
+(all-to-all back) — the reference's ``Ialltoallv`` pattern
+(ops_mpi.hpp:82-119) with MXU compute between the two exchanges.
+
+Design:
+
+* **Routing is host-side setup** (the analog of ``RowPartSpmv``'s send/recv
+  negotiation, row_part_spmv.cuh:259-423): top-1 gating over a fixed gate
+  matrix is evaluated on the host when buffers are built, producing static
+  per-(shard, expert) slot tables — ``disp_idx`` (which local token fills
+  each capacity slot) and ``disp_w`` (its gate weight; 0 marks padding).
+  Raggedness is handled by padding every (src, dst) pair to the common
+  capacity, exactly like the irregular SpMV's width-padded lists — there is
+  no ragged all-to-all on ICI.
+* **The data plane is schedulable.**  Tokens are split into ``n_chunks``
+  microbatch chunks; each chunk is an independent chain
+
+      pack_c -> a2a_disp_c(post) -> await -> ffn_c -> a2a_comb_c(post)
+             -> await -> combine_c
+
+  so the solver can pipeline chunks: expert compute of chunk 0 overlaps the
+  dispatch of chunk 1 (the schedule MoE systems hand-tune; here it is
+  *searched*).  The reference hard-codes its overlap discipline with
+  post-all-before-wait-any edges (ops_halo_exchange.cu:249-256); this graph
+  deliberately leaves that freedom to the search.
+* The expert FFN (gelu MLP, the MXU hot spot) has an implementation ChoiceOp:
+  XLA einsums vs the Pallas tiled-matmul kernel (ops/ffn_pallas.py).
+
+Numerics are checked against a dense host evaluation of the routed layer
+(tests/test_moe.py; ``dryrun_multichip`` covers the full sharded path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import ChoiceOp, CompoundOp, DeviceOp, OpBase
+from tenzing_tpu.ops.comm_ops import AllToAllStart, AwaitTransfer
+
+AXIS = "ep"
+
+
+@dataclass(frozen=True)
+class MoEArgs:
+    n_ep: int  # expert-parallel shards == experts (one expert per shard)
+    tokens_per_shard: int = 16
+    d_model: int = 8
+    d_ff: int = 16
+    n_chunks: int = 2  # microbatch chunks (the pipelining freedom)
+    dtype: str = "float32"
+
+    @property
+    def chunk_tokens(self) -> int:
+        assert self.tokens_per_shard % self.n_chunks == 0
+        return self.tokens_per_shard // self.n_chunks
+
+
+from tenzing_tpu.utils.numeric import gelu_tanh as _gelu
+
+
+class DispatchPack(DeviceOp):
+    """Fill chunk ``c``'s capacity-padded send buffer from the local tokens the
+    router assigned to each expert (the gather the reference's Scatter op does
+    for the Ialltoallv send buffer, ops_spmv.cuh:194-215)."""
+
+    def __init__(self, name: str, c: int, args: MoEArgs):
+        super().__init__(name)
+        self._c = c
+        self._args = args
+
+    def reads(self):
+        return ["X", f"disp_idx_{self._c}"]
+
+    def writes(self):
+        return [f"send_disp_{self._c}"]
+
+    def apply(self, bufs, ctx):
+        tc_ = self._args.chunk_tokens
+        xc = bufs["X"][self._c * tc_ : (self._c + 1) * tc_]  # (Tc, d)
+        idx = bufs[f"disp_idx_{self._c}"][0]  # (n_ep, C)
+        return {f"send_disp_{self._c}": xc[idx]}  # (n_ep, C, d)
+
+
+class ExpertFFN(DeviceOp):
+    """Run the resident expert's gelu MLP over every received token (the MXU
+    compute between the two exchanges).  Padding slots carry real numbers but
+    combine multiplies them by weight 0."""
+
+    def __init__(self, name: str, c: int, args: MoEArgs):
+        super().__init__(name)
+        self._c = c
+        self._args = args
+
+    def reads(self):
+        return [f"recv_disp_{self._c}", "W1", "W2"]
+
+    def writes(self):
+        return [f"ffn_out_{self._c}"]
+
+    def _mlp(self, x2d, w1, w2):
+        import jax
+        import jax.numpy as jnp
+
+        h = jax.nn.gelu(jnp.dot(x2d, w1, preferred_element_type=jnp.float32))
+        return jnp.dot(h.astype(x2d.dtype), w2, preferred_element_type=jnp.float32)
+
+    def apply(self, bufs, ctx):
+        x = bufs[f"recv_disp_{self._c}"]  # (n_ep, C, d) rows by source shard
+        w1, w2 = bufs["W1"][0], bufs["W2"][0]  # this shard's expert
+        n, cap, d = x.shape
+        y = self._mlp(x.reshape(n * cap, d), w1, w2).astype(x.dtype)
+        return {f"ffn_out_{self._c}": y.reshape(n, cap, d)}
+
+
+class ExpertFFNPallas(ExpertFFN):
+    """Same MLP through the Pallas tiled-matmul kernel (ops/ffn_pallas.py)."""
+
+    def _mlp(self, x2d, w1, w2):
+        from tenzing_tpu.ops.ffn_pallas import ffn_pallas
+
+        return ffn_pallas(x2d, w1, w2)
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
+class ExpertFFNChoice(ChoiceOp):
+    """Kernel menu for chunk ``c``'s expert MLP: XLA einsums vs Pallas tiles."""
+
+    def __init__(self, name: str, c: int, args: MoEArgs):
+        super().__init__(name)
+        self._c = c
+        self._args = args
+
+    def choices(self) -> List[OpBase]:
+        return [
+            ExpertFFN(self.name() + ".xla", self._c, self._args),
+            ExpertFFNPallas(self.name() + ".pallas", self._c, self._args),
+        ]
+
+
+class CombineScatter(DeviceOp):
+    """Scatter-add the returned expert outputs back into token order, scaled
+    by the gate weights (padding slots have weight 0)."""
+
+    def __init__(self, name: str, c: int, args: MoEArgs):
+        super().__init__(name)
+        self._c = c
+        self._args = args
+
+    def reads(self):
+        return [f"recv_comb_{self._c}", f"disp_idx_{self._c}", f"disp_w_{self._c}"]
+
+    def writes(self):
+        return [f"Y_{self._c}"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        vals = bufs[f"recv_comb_{self._c}"]  # (n_ep, C, d) rows by expert
+        idx = bufs[f"disp_idx_{self._c}"][0].reshape(-1)  # (n_ep*C,)
+        w = bufs[f"disp_w_{self._c}"][0].reshape(-1, 1)  # (n_ep*C, 1)
+        d = vals.shape[-1]
+        y = jnp.zeros((self._args.chunk_tokens, d), vals.dtype)
+        return {f"Y_{self._c}": y.at[idx].add(w * vals.reshape(-1, d))}
+
+
+class ConcatChunks(DeviceOp):
+    """Stitch the per-chunk outputs back into the token-order output."""
+
+    def __init__(self, name: str, args: MoEArgs):
+        super().__init__(name)
+        self._args = args
+
+    def reads(self):
+        return [f"Y_{c}" for c in range(self._args.n_chunks)]
+
+    def writes(self):
+        return ["Y"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        return {
+            "Y": jnp.concatenate(
+                [bufs[f"Y_{c}"] for c in range(self._args.n_chunks)], axis=0
+            )
+        }
+
+
+class MoELayer(CompoundOp):
+    """The whole EP layer as one compound: ``n_chunks`` independent
+    dispatch -> expert -> combine chains joined by the final concat.  With
+    ``impl_choice`` each chunk's FFN kernel is searched."""
+
+    def __init__(self, args: MoEArgs, name: str = "moe", impl_choice: bool = False):
+        super().__init__(name)
+        self._args = args
+        self._impl_choice = impl_choice
+
+    def args(self) -> MoEArgs:
+        return self._args
+
+    def graph(self) -> Graph:
+        g = Graph()
+        cat = ConcatChunks("moe_concat", self._args)
+        mk = ExpertFFNChoice if self._impl_choice else ExpertFFN
+        for c in range(self._args.n_chunks):
+            pack = DispatchPack(f"pack_{c}", c, self._args)
+            disp = AllToAllStart(
+                f"a2a_disp_{c}", f"send_disp_{c}", f"recv_disp_{c}", AXIS,
+                split_axis=0,
+            )
+            adisp = AwaitTransfer(f"await_disp_{c}", f"recv_disp_{c}")
+            ffn = mk(f"ffn_{c}", c, self._args)
+            comb = AllToAllStart(
+                f"a2a_comb_{c}", f"ffn_out_{c}", f"recv_comb_{c}", AXIS,
+                split_axis=0,
+            )
+            acomb = AwaitTransfer(f"await_comb_{c}", f"recv_comb_{c}")
+            scat = CombineScatter(f"combine_{c}", c, self._args)
+            g.start_then(pack)
+            g.then(pack, disp)
+            g.then(disp, adisp)
+            g.then(adisp, ffn)
+            g.then(ffn, comb)
+            g.then(comb, acomb)
+            g.then(acomb, scat)
+            g.then(scat, cat)
+        g.then_finish(cat)
+        return g
+
+
+def make_moe_buffers(
+    args: MoEArgs, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
+    """(buffers, partition specs, expected Y) for the EP layer on a 1-D
+    ``("ep",)`` mesh.  Routing (top-1 gating) runs here, on the host, against
+    a fixed random gate matrix — the setup-negotiation analog; its product is
+    the static slot tables the device ops consume."""
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(seed)
+    n, t, d, dff = args.n_ep, args.tokens_per_shard, args.d_model, args.d_ff
+    tc_ = args.chunk_tokens
+    dt = np.dtype(args.dtype)
+    x = rng.standard_normal((n * t, d)).astype(dt)
+    wg = rng.standard_normal((d, n)).astype(dt)
+    w1 = rng.standard_normal((n, d, dff)).astype(dt) / np.sqrt(d)
+    w2 = rng.standard_normal((n, dff, d)).astype(dt) / np.sqrt(dff)
+
+    # host routing: top-1 expert + softmax gate weight per token
+    logits = x.astype(np.float64) @ wg.astype(np.float64)  # (n*t, n)
+    expert = np.argmax(logits, axis=1)
+    pz = np.exp(logits - logits.max(axis=1, keepdims=True))
+    pz /= pz.sum(axis=1, keepdims=True)
+    gate = pz[np.arange(n * t), expert]  # (n*t,)
+
+    # capacity: max tokens any (shard, chunk) sends to any expert
+    cap = 1
+    for s in range(n):
+        for c in range(args.n_chunks):
+            lo = s * t + c * tc_
+            e_blk = expert[lo : lo + tc_]
+            if len(e_blk):
+                cap = max(cap, int(np.bincount(e_blk, minlength=n).max()))
+
+    bufs: Dict[str, np.ndarray] = {
+        "X": x,
+        "W1": w1,
+        "W2": w2,
+        "Y": np.zeros((n * t, d), dt),
+    }
+    specs: Dict[str, object] = {
+        "X": P(AXIS, None),
+        "W1": P(AXIS, None, None),
+        "W2": P(AXIS, None, None),
+        "Y": P(AXIS, None),
+    }
+    for c in range(args.n_chunks):
+        idx = np.zeros((n, n, cap), dtype=np.int32)
+        w = np.zeros((n, n, cap), dtype=dt)
+        for s in range(n):
+            lo = s * t + c * tc_
+            fill = [0] * n
+            for j in range(tc_):
+                e = int(expert[lo + j])
+                idx[s, e, fill[e]] = j
+                w[s, e, fill[e]] = gate[lo + j]
+                fill[e] += 1
+        bufs[f"disp_idx_{c}"] = idx
+        bufs[f"disp_w_{c}"] = w
+        specs[f"disp_idx_{c}"] = P(AXIS, None, None)
+        specs[f"disp_w_{c}"] = P(AXIS, None, None)
+        for nm in (f"send_disp_{c}", f"recv_disp_{c}", f"ffn_out_{c}",
+                   f"recv_comb_{c}"):
+            bufs[nm] = np.zeros((n * n, cap, d), dt)
+            specs[nm] = P(AXIS, None, None)
+        bufs[f"Y_{c}"] = np.zeros((n * tc_, d), dt)
+        specs[f"Y_{c}"] = P(AXIS, None)
+
+    # dense host reference: y[t] = gate * expert_e(x[t]) in float64
+    x64 = x.astype(np.float64)
+    want = np.zeros((n * t, d), np.float64)
+    for e in range(n):
+        sel = expert == e
+        h = _gelu(x64[sel] @ w1[e].astype(np.float64))
+        want[sel] = gate[sel, None] * (h @ w2[e].astype(np.float64))
+    return bufs, specs, want.astype(np.float32)

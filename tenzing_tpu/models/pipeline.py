@@ -1,0 +1,262 @@
+"""Pipeline parallelism: microbatch pipelining as a searchable op DAG.
+
+The reference has no model layers (SURVEY.md §2.5: TP/PP/EP absent; the op-DAG
+must nonetheless *express* such programs).  This model is the
+pipeline-parallel (PP) member of that family: stage ``s`` of an ``S``-stage
+network lives on mesh-axis-``pp`` shard ``s``, and activations flow stage to
+stage over ICI.  In SPMD form every device runs the same per-tick program —
+compute the resident stage on the resident microbatch, then shift activations
+one hop forward (`lax.ppermute`) — and a microbatch emerges from the last
+stage ``S-1`` ticks after it was injected at stage 0.
+
+What makes it a *search* problem (the whole point of this framework): the
+microbatches are split across ``n_chains`` independent virtual pipelines,
+each with its own double-buffer-free serial tick chain
+
+    inject_t -> compute_t -> rotate_t(post) -> await_t -> inject_{t+1} -> ...
+                         \\-> collect_t   (once the pipe is full)
+
+and the chains share nothing until the final interleave.  The solver's
+order/lane freedom across chains is exactly the 1F1B-style interleaving
+question: a good schedule hides chain A's ICI rotate behind chain B's stage
+compute (the post/wait split of ``rotate`` is the reference's Isend/Wait
+split, ops_mpi.hpp:17-146).  Hand-tuned PP runtimes bake one such schedule
+in; here it is searched and benchmarked.
+
+Numerics are checked against the host evaluation of the full stage stack per
+microbatch (tests/test_pipeline.py; ``dryrun_multichip`` covers the sharded
+path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+from tenzing_tpu.ops.comm_ops import AwaitTransfer, PermuteStart
+
+AXIS = "pp"
+
+
+@dataclass(frozen=True)
+class PipelineArgs:
+    n_pp: int  # pipeline stages == mesh shards
+    n_microbatches: int = 4
+    n_chains: int = 2  # interleaved virtual pipelines (the searched freedom)
+    mb_size: int = 4  # rows per microbatch
+    d_model: int = 8
+    dtype: str = "float32"
+
+    @property
+    def chain_microbatches(self) -> int:
+        assert self.n_microbatches % self.n_chains == 0
+        return self.n_microbatches // self.n_chains
+
+    @property
+    def chain_ticks(self) -> int:
+        return self.chain_microbatches + self.n_pp - 1
+
+
+def _act(v: int, t: int) -> str:
+    """Activation buffer chain ``v`` reads at tick ``t`` (ping-pong pair)."""
+    return f"act_{v}_{t % 2}"
+
+
+class Inject(DeviceOp):
+    """Tick ``t`` < M_v: stage 0 swaps microbatch ``t``'s input into its
+    activation slot (other stages keep what the rotate delivered)."""
+
+    def __init__(self, name: str, v: int, t: int):
+        super().__init__(name)
+        self._v, self._t = v, t
+
+    def reads(self):
+        return [_act(self._v, self._t), f"X_{self._v}"]
+
+    def writes(self):
+        return [_act(self._v, self._t)]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+        from jax import lax
+
+        p = lax.axis_index(AXIS)
+        x = bufs[f"X_{self._v}"][self._t]  # (B, d) replicated
+        act = bufs[_act(self._v, self._t)]
+        return {_act(self._v, self._t): jnp.where(p == 0, x, act)}
+
+
+class StageCompute(DeviceOp):
+    """Apply the resident stage's layer to the resident activation (every
+    stage computes every tick — SPMD; ticks whose slot holds no live
+    microbatch produce garbage that is never collected)."""
+
+    def __init__(self, name: str, v: int, t: int):
+        super().__init__(name)
+        self._v, self._t = v, t
+
+    def reads(self):
+        return [_act(self._v, self._t), "W"]
+
+    def writes(self):
+        return [f"out_{self._v}"]
+
+    def apply(self, bufs, ctx):
+        import jax
+        import jax.numpy as jnp
+
+        w = bufs["W"][0]  # this shard's stage weights (d, d)
+        act = bufs[_act(self._v, self._t)]
+        return {
+            f"out_{self._v}": jax.nn.gelu(
+                jnp.dot(act, w, preferred_element_type=jnp.float32)
+            ).astype(act.dtype)
+        }
+
+
+class Collect(DeviceOp):
+    """Tick ``t`` >= S-1: the last stage banks microbatch ``t-(S-1)``'s
+    finished output into its slot of the chain's result buffer."""
+
+    def __init__(self, name: str, v: int, t: int, args: PipelineArgs):
+        super().__init__(name)
+        self._v, self._t = v, t
+        self._args = args
+
+    def reads(self):
+        return [f"out_{self._v}", f"Y_{self._v}"]
+
+    def writes(self):
+        return [f"Y_{self._v}"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+        from jax import lax
+
+        p = lax.axis_index(AXIS)
+        m = self._t - (self._args.n_pp - 1)
+        yv = bufs[f"Y_{self._v}"]  # (M_v, B, d) per shard
+        upd = yv.at[m].set(bufs[f"out_{self._v}"])
+        return {f"Y_{self._v}": jnp.where(p == self._args.n_pp - 1, upd, yv)}
+
+
+class InterleaveY(DeviceOp):
+    """Merge the chains' results back into microbatch order
+    (chain ``v`` slot ``j`` holds microbatch ``v + j*n_chains``)."""
+
+    def __init__(self, name: str, args: PipelineArgs):
+        super().__init__(name)
+        self._args = args
+
+    def reads(self):
+        return [f"Y_{v}" for v in range(self._args.n_chains)]
+
+    def writes(self):
+        return ["Y"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        chains = jnp.stack(
+            [bufs[f"Y_{v}"] for v in range(self._args.n_chains)], axis=1
+        )  # (M_v, V, B, d)
+        mv, v, b, d = chains.shape
+        return {"Y": chains.reshape(mv * v, b, d)}
+
+
+class Pipeline(CompoundOp):
+    """The whole pipelined forward as one compound op: ``n_chains``
+    independent tick chains, each with the post/wait-split rotate, joined by
+    the final interleave."""
+
+    def __init__(self, args: PipelineArgs, name: str = "pipeline"):
+        super().__init__(name)
+        self._args = args
+
+    def args(self) -> PipelineArgs:
+        return self._args
+
+    def graph(self) -> Graph:
+        a = self._args
+        g = Graph()
+        inter = InterleaveY("pp_interleave", a)
+        for v in range(a.n_chains):
+            mv, ticks = a.chain_microbatches, a.chain_ticks
+            prev_entry = None  # the op that delivers tick t's activation
+            prev_collect = None
+            for t in range(ticks):
+                comp = StageCompute(f"compute_{v}_{t}", v, t)
+                if t < mv:
+                    inj = Inject(f"inject_{v}_{t}", v, t)
+                    if prev_entry is None:
+                        g.start_then(inj)
+                    else:
+                        g.then(prev_entry, inj)
+                    g.then(inj, comp)
+                else:
+                    g.then(prev_entry, comp)
+                if prev_collect is not None:
+                    # WAR: compute_t overwrites out_v that collect_{t-1} read
+                    g.then(prev_collect, comp)
+                if t < ticks - 1:
+                    post = PermuteStart(
+                        f"rotate_{v}_{t}", f"out_{v}", _act(v, t + 1), AXIS
+                    )
+                    await_ = AwaitTransfer(f"await_{v}_{t}", _act(v, t + 1))
+                    g.then(comp, post)
+                    g.then(post, await_)
+                    prev_entry = await_
+                if t >= a.n_pp - 1:
+                    col = Collect(f"collect_{v}_{t}", v, t, a)
+                    g.then(comp, col)
+                    if prev_collect is not None:
+                        g.then(prev_collect, col)  # RAW: Y_v chain
+                    prev_collect = col
+            g.then(prev_collect, inter)
+        g.then_finish(inter)
+        return g
+
+
+def make_pipeline_buffers(
+    args: PipelineArgs, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
+    """(buffers, partition specs, expected Y) for the PP forward on a 1-D
+    ``("pp",)`` mesh.  Expected Y is zero except the last stage's shard block,
+    where microbatch ``m``'s slot holds the full stage stack applied to its
+    input (computed densely on the host in float64)."""
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(seed)
+    s, m, v = args.n_pp, args.n_microbatches, args.n_chains
+    b, d = args.mb_size, args.d_model
+    mv = args.chain_microbatches
+    dt = np.dtype(args.dtype)
+    x = rng.standard_normal((m, b, d)).astype(dt)
+    w = rng.standard_normal((s, d, d)).astype(dt) / np.sqrt(d)
+
+    from tenzing_tpu.utils.numeric import gelu_tanh
+
+    y = x.astype(np.float64)
+    for st in range(s):
+        y = gelu_tanh(y @ w[st].astype(np.float64))
+
+    bufs: Dict[str, np.ndarray] = {"W": w, "Y": np.zeros((s * m, b, d), dt)}
+    specs: Dict[str, object] = {"W": P(AXIS, None, None), "Y": P(AXIS, None, None)}
+    for c in range(v):
+        bufs[f"X_{c}"] = x[c::v]  # (M_v, B, d), chain c's microbatches
+        specs[f"X_{c}"] = P(None, None, None)  # replicated: stage 0 reads it
+        for par in (0, 1):
+            bufs[f"act_{c}_{par}"] = np.zeros((s * b, d), dt)
+            specs[f"act_{c}_{par}"] = P(AXIS, None)
+        bufs[f"out_{c}"] = np.zeros((s * b, d), dt)
+        specs[f"out_{c}"] = P(AXIS, None)
+        bufs[f"Y_{c}"] = np.zeros((s * mv, b, d), dt)
+        specs[f"Y_{c}"] = P(AXIS, None, None)
+
+    want = np.zeros((s * m, b, d), np.float32)
+    want[(s - 1) * m : s * m] = y.astype(np.float32)  # last stage's block
+    return bufs, specs, want

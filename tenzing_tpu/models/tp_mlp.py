@@ -1,0 +1,189 @@
+"""Tensor parallelism: a Megatron-style sharded MLP as a searchable op DAG.
+
+The reference has no model layers (SURVEY.md §2.5: TP/PP/EP absent; the op-DAG
+must nonetheless *express* such programs).  This model is the tensor-parallel
+(TP) member of that family: each layer's first matmul is column-sharded over
+mesh axis ``"tp"`` and the second row-sharded, so every shard computes a
+*partial* layer output that an all-reduce (``lax.psum``) completes —
+
+    h_p    = gelu(x @ W1[:, p-th column block])      (local, MXU)
+    part_p = h_p @ W2[p-th row block]                (local, MXU)
+    y      = sum_p part_p                            (all-reduce over ICI)
+
+The all-reduce is the schedulable transfer: :class:`~tenzing_tpu.ops.comm_ops.
+PsumStart` posts it and ``AwaitTransfer`` joins its completion, the same
+post/wait split as every other comm op (reference Isend/Wait,
+ops_mpi.hpp:17-146).  Within one chain the layers are serial (layer ``l+1``
+consumes the reduced output of layer ``l``), so the schedule freedom comes
+from splitting the batch into ``n_chunks`` independent microbatch chains:
+a good schedule hides chunk A's all-reduce behind chunk B's matmuls — the
+overlap TP training stacks hand-implement; here it is searched.
+
+Numerics are checked against the host evaluation of the unsharded layer stack
+(tests/test_tp_mlp.py; ``dryrun_multichip`` covers the sharded path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+from tenzing_tpu.ops.comm_ops import AwaitTransfer, PsumStart
+
+AXIS = "tp"
+
+
+@dataclass(frozen=True)
+class TpMlpArgs:
+    n_tp: int  # tensor-parallel shards
+    n_layers: int = 2
+    n_chunks: int = 2  # independent microbatch chains (the searched freedom)
+    mb_size: int = 4  # rows per chunk
+    d_model: int = 8
+    d_ff: int = 16  # global hidden width (sharded n_tp ways)
+    dtype: str = "float32"
+
+
+class TpLayerPartial(DeviceOp):
+    """One layer's local half: gelu(x @ W1-column-block) @ W2-row-block —
+    both matmuls on the MXU, producing this shard's partial output."""
+
+    def __init__(self, name: str, c: int, layer: int):
+        super().__init__(name)
+        self._c, self._l = c, layer
+
+    def _in(self) -> str:
+        return f"X_{self._c}" if self._l == 0 else f"sum_{self._c}_{self._l - 1}"
+
+    def reads(self):
+        return [self._in(), "W1", "W2"]
+
+    def writes(self):
+        return [f"part_{self._c}_{self._l}"]
+
+    def apply(self, bufs, ctx):
+        import jax
+        import jax.numpy as jnp
+
+        x = bufs[self._in()]  # (B, d) replicated across tp
+        w1 = bufs["W1"][self._l, :, :]  # (d, dff_local) this shard's columns
+        w2 = bufs["W2"][self._l, :, :]  # (dff_local, d) this shard's rows
+        h = jax.nn.gelu(jnp.dot(x, w1, preferred_element_type=jnp.float32))
+        part = jnp.dot(h.astype(x.dtype), w2, preferred_element_type=jnp.float32)
+        return {f"part_{self._c}_{self._l}": part.astype(x.dtype)}
+
+
+class ConcatOut(DeviceOp):
+    """Stack the chunks' final reduced outputs back into batch order."""
+
+    def __init__(self, name: str, args: TpMlpArgs):
+        super().__init__(name)
+        self._args = args
+
+    def reads(self):
+        last = self._args.n_layers - 1
+        return [f"sum_{c}_{last}" for c in range(self._args.n_chunks)]
+
+    def writes(self):
+        return ["Y"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        last = self._args.n_layers - 1
+        return {
+            "Y": jnp.concatenate(
+                [bufs[f"sum_{c}_{last}"] for c in range(self._args.n_chunks)],
+                axis=0,
+            )
+        }
+
+
+class TpMlp(CompoundOp):
+    """The whole TP forward as one compound: ``n_chunks`` independent
+    layer chains (partial -> psum-post -> await per layer), joined by the
+    final concat."""
+
+    def __init__(self, args: TpMlpArgs, name: str = "tp_mlp"):
+        super().__init__(name)
+        self._args = args
+
+    def args(self) -> TpMlpArgs:
+        return self._args
+
+    def graph(self) -> Graph:
+        a = self._args
+        g = Graph()
+        cat = ConcatOut("tp_concat", a)
+        for c in range(a.n_chunks):
+            prev = None
+            for l in range(a.n_layers):
+                mlp = TpLayerPartial(f"mlp_{c}_{l}", c, l)
+                post = PsumStart(
+                    f"psum_{c}_{l}", f"part_{c}_{l}", f"sum_{c}_{l}", AXIS
+                )
+                await_ = AwaitTransfer(f"await_{c}_{l}", f"sum_{c}_{l}")
+                if prev is None:
+                    g.start_then(mlp)
+                else:
+                    g.then(prev, mlp)
+                g.then(mlp, post)
+                g.then(post, await_)
+                prev = await_
+            g.then(prev, cat)
+        g.then_finish(cat)
+        return g
+
+
+def make_tp_mlp_buffers(
+    args: TpMlpArgs, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
+    """(buffers, partition specs, expected Y) for the TP forward on a 1-D
+    ``("tp",)`` mesh.  W1 is column-sharded, W2 row-sharded (Megatron layout);
+    chunk inputs are replicated; written activations are shard-stacked (see
+    the layout note below)."""
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(seed)
+    L, v = args.n_layers, args.n_chunks
+    b, d, dff = args.mb_size, args.d_model, args.d_ff
+    assert dff % args.n_tp == 0, "d_ff must divide across tp shards"
+    dt = np.dtype(args.dtype)
+    x = rng.standard_normal((v * b, d)).astype(dt)
+    w1 = rng.standard_normal((L, d, dff)).astype(dt) / np.sqrt(d)
+    w2 = rng.standard_normal((L, dff, d)).astype(dt) / np.sqrt(dff)
+
+    from tenzing_tpu.utils.numeric import gelu_tanh
+
+    y = x.astype(np.float64)
+    for l in range(L):
+        y = gelu_tanh(y @ w1[l].astype(np.float64)) @ w2[l].astype(np.float64)
+
+    # written buffers are laid out shard-stacked, P("tp", None), even where
+    # the math makes every shard's block identical (post-psum sums, Y): the
+    # executor's ordering tokens are shard-varying, and a tied value cannot
+    # satisfy a statically-replicated out_spec under shard_map's vma check
+    bufs: Dict[str, np.ndarray] = {
+        "W1": w1,
+        "W2": w2,
+        "Y": np.zeros((args.n_tp * v * b, d), dt),
+    }
+    specs: Dict[str, object] = {
+        "W1": P(None, None, AXIS),  # column-sharded
+        "W2": P(None, AXIS, None),  # row-sharded
+        "Y": P(AXIS, None),
+    }
+    for c in range(v):
+        bufs[f"X_{c}"] = x[c * b : (c + 1) * b]
+        specs[f"X_{c}"] = P(None, None)  # replicated input, never written
+        for l in range(L):
+            bufs[f"part_{c}_{l}"] = np.zeros((args.n_tp * b, d), dt)
+            specs[f"part_{c}_{l}"] = P(AXIS, None)
+            bufs[f"sum_{c}_{l}"] = np.zeros((args.n_tp * b, d), dt)
+            specs[f"sum_{c}_{l}"] = P(AXIS, None)
+    want = np.tile(y.astype(np.float32), (args.n_tp, 1))
+    return bufs, specs, want

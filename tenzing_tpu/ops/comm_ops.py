@@ -190,6 +190,29 @@ class AllToAllStart(CommStart):
         return j
 
 
+@register_kind("psum_start")
+class PsumStart(CommStart):
+    """Post an all-reduce (sum) of ``src`` over mesh axis ``axis`` into
+    ``dst`` — the collective analog of the reference's nonblocking collective
+    (Ialltoallv, ops_mpi.hpp:82-119) for the tensor-parallel pattern: XLA
+    lowers it to all-reduce-start/done, and the await placement decides how
+    much compute hides the reduction."""
+
+    def __init__(self, name: str, src: str, dst: str, axis: str):
+        super().__init__(name, src, dst)
+        self._axis = axis
+
+    def apply(self, bufs, ctx):
+        import jax
+
+        return {self._dst: jax.lax.psum(bufs[self._src], self._axis)}
+
+    def to_json(self) -> Dict[str, Any]:
+        j = super().to_json()
+        j.update(axis=self._axis)
+        return j
+
+
 @register_kind("await_transfer")
 class AwaitTransfer(CpuOp):
     """Wait for an in-flight buffer: joins its completion into the host chain

@@ -1,0 +1,70 @@
+"""Pallas tiled gelu-MLP kernel: y = gelu(x @ W1) @ W2.
+
+The MXU kernel of the MoE expert (models/moe.py): both matmuls and the gelu
+fused into one VMEM-resident pass per row tile, so the hidden activations
+h = gelu(x W1) never round-trip through HBM (the fusion XLA usually finds on
+its own; doing it in Pallas makes the kernel an honest menu alternative the
+search can time, like ops/spmv_pallas.py vs the XLA gather path).
+
+The grid runs over row tiles of x; each program loads one (bm, d) tile plus
+both weight matrices (d x dff and dff x d — VMEM-sized for the model dims this
+framework targets) and writes one output tile.  Ragged row counts are padded
+up to the tile and sliced back off (rows are independent; pad rows compute
+finite garbage that is discarded).
+
+``interpret=True`` (automatic off-TPU) runs the kernel in the Pallas
+interpreter for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tenzing_tpu.ops.common import out_struct
+
+
+def _ffn_kernel(x_ref, w1_ref, w2_ref, y_out):
+    x = x_ref[...]  # (bm, d)
+    h = jax.nn.gelu(
+        jnp.dot(x, w1_ref[...], preferred_element_type=jnp.float32)
+    )
+    y_out[...] = jnp.dot(
+        h.astype(x.dtype), w2_ref[...], preferred_element_type=jnp.float32
+    ).astype(y_out.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ffn_pallas(
+    x: jax.Array,
+    w1: jax.Array,
+    w2: jax.Array,
+    *,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """gelu MLP over row-tiled x: x (n, d), w1 (d, dff), w2 (dff, d) -> (n, d)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d = x.shape
+    bm = min(n, 512)
+    pad = (-n) % bm
+    np_ = n + pad
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        _ffn_kernel,
+        grid=(np_ // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i: (i, 0)),
+            pl.BlockSpec(w1.shape, lambda i: (0, 0)),
+            pl.BlockSpec(w2.shape, lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, d), lambda i: (i, 0)),
+        out_shape=out_struct((np_, d), x.dtype, x, w1, w2),
+        interpret=interpret,
+    )(x, w1, w2)
+    return out[:n] if pad else out

@@ -29,12 +29,12 @@ class DfsOpts:
     """reference dfs::Opts (dfs.hpp:30-40; maxSeqs cap from examples/spmv.cu:117).
 
     ``batch=True`` benchmarks the whole enumerated set through
-    ``benchmark_batch`` — every schedule visited once per iteration in a fresh
-    random order (reference batch benchmark, benchmarker.cpp:21-76) — so slow
-    system drift decorrelates from schedule identity and cross-schedule
+    ``benchmark_batch_times`` — every schedule visited once per iteration in a
+    fresh random order (reference batch benchmark, benchmarker.cpp:21-76) — so
+    slow system drift decorrelates from schedule identity and cross-schedule
     comparisons in the dumped database are honest.  Falls back to one-at-a-time
-    benchmarking when the benchmarker has no ``benchmark_batch`` (e.g. CSV
-    replay) or under a multi-host control plane (the batch path is
+    benchmarking when the benchmarker has no ``benchmark_batch_times`` (e.g.
+    CSV replay) or under a multi-host control plane (the batch path is
     single-host)."""
 
     max_seqs: int = 15000
@@ -236,18 +236,19 @@ def explore(
         n = cp.bcast_json(n)  # stop-flag protocol (dfs.hpp:50-70)
         batch_times_fn = getattr(benchmarker, "benchmark_batch_times", None)
         if opts.batch and (batch_times_fn is None or cp.size() != 1):
-            import sys
+            if cp.rank() == 0:
+                import sys
 
-            why = (
-                "multi-host control plane"
-                if cp.size() != 1
-                else f"{type(benchmarker).__name__} has no benchmark_batch_times"
-            )
-            print(
-                f"tenzing-tpu: dfs batch=True ignored ({why}); falling back to "
-                "one-at-a-time (correlated) benchmarking",
-                file=sys.stderr,
-            )
+                why = (
+                    "multi-host control plane"
+                    if cp.size() != 1
+                    else f"{type(benchmarker).__name__} has no benchmark_batch_times"
+                )
+                print(
+                    f"tenzing-tpu: dfs batch=True ignored ({why}); falling back "
+                    "to one-at-a-time (correlated) benchmarking",
+                    file=sys.stderr,
+                )
         if opts.batch and batch_times_fn is not None and cp.size() == 1:
             orders = [st.sequence for st in states]
             times: List[List[float]] = [[] for _ in orders]
@@ -255,11 +256,14 @@ def explore(
             batch_times_fn(
                 orders, opts.bench_opts, seed=opts.batch_seed, times_out=times
             )
-            batch_partial.clear()
             for order, ts in zip(orders, times):
                 result.sims.append(
                     SimResult(order=order, result=BenchResult.from_times(ts))
                 )
+            # only after the results are in result.sims: a signal landing
+            # between clear() and the copy would otherwise dump an empty CSV
+            # despite every measurement having completed (trap.py contract)
+            batch_partial.clear()
         else:
             for i in range(n):
                 if cp.rank() == 0:

@@ -43,6 +43,15 @@ def corr(xs: Sequence[float], ys: Sequence[float]) -> float:
     return cov / (sx * sy)
 
 
+def gelu_tanh(x):
+    """tanh-approximate gelu on a numpy array — matches ``jax.nn.gelu``'s
+    default exactly, so host-side model references agree with the device path
+    (shared by the MoE / pipeline / TP-MLP buffer builders)."""
+    import numpy as np
+
+    return 0.5 * x * (1.0 + np.tanh(np.sqrt(2.0 / np.pi) * (x + 0.044715 * x**3)))
+
+
 def prime_factors(n: int) -> List[int]:
     """Ascending prime factorization (reference numeric.cpp:11-33; used for
     device-grid layout, halo_run_strategy.hpp:80-98)."""

@@ -106,6 +106,29 @@ def test_round2_recording_also_replays():
     assert out["n"] == n_rows
 
 
+def test_round2c_recording_replays_with_decisive_margin():
+    """The round-2 re-run under the tightened verdict protocol (3x final
+    iterations, 20x measurement floor — bench.py): paired speedup 1.198,
+    95% CI [1.189, 1.207].  The recording replays, and the recorded final
+    -batch margin itself is decisive: best candidate under naive by more
+    than both stddevs."""
+    path = os.path.join(REPO, "experiments", "halo_search_tpu_r2c.csv")
+    n_rows = sum(1 for line in open(path) if line.strip())
+    g = build_graph(ARGS, impl_choice=True)
+    db = CsvBenchmarker.from_file(path, g, strict=False)
+    g_plain = build_graph(ARGS, impl_choice=False)
+    db_plain = CsvBenchmarker.from_file(path, g_plain, strict=False)
+    assert len(db.entries) == n_rows - 2 and db.skipped == [0, 1]
+    assert len(db_plain.entries) == 2
+    naive = db_plain.entries[0][1]
+    best = min(
+        [db_plain.entries[1][1]] + [r for _, r in db.entries],
+        key=lambda r: r.pct50,
+    )
+    assert best.pct50 < naive.pct50
+    assert naive.pct50 - best.pct50 > max(best.stddev, naive.stddev)
+
+
 def test_postprocess_on_real_recorded_data():
     """Class-boundary + decision-tree analysis runs on the real CSV and finds
     the searched-fast vs naive-slow structure."""
