@@ -12,6 +12,10 @@ Workloads (``--workload``):
   nnz=10*m, band matrix, 2 lanes — spmv_run_strategy.cuh:44-47).
 * ``attn``: single-chip blockwise (flash) attention over a long context —
   the kernel menu (XLA vs Pallas MXU) plus order x lane space.
+* ``moe``: single-chip MoE dispatch/combine pipeline — routed tokens staged
+  through async host round-trip DMAs to the resident experts (the
+  expert-parallel network-hop analog), searched over order x lane x
+  expert-kernel (XLA vs Pallas) across independent microbatch chunk chains.
 
 The search is anytime and starts from the naive incumbent: MCTS (FastMin
 strategy) spends a fixed compile budget exploring the schedule space.  The
@@ -70,6 +74,9 @@ def metric_for(workload: str, args) -> str:
     if workload == "spmv":
         m = args.m if args.m is not None else (512 if args.smoke else 150_000)
         return f"spmv_iter_pct50_searched_m{m}"
+    if workload == "moe":
+        t = 32 if args.smoke else args.moe_tokens
+        return f"moe_pipe_pct50_searched_t{t}"
     n_ctx = 4 * 16 if args.smoke else 8 * 1024
     return f"attn_blockwise_pct50_searched_n{n_ctx}"
 
@@ -124,6 +131,27 @@ def build_spmv(args):
     return g, jbufs, metric_for("spmv", args)
 
 
+def build_moe(args):
+    from tenzing_tpu.models.moe_pipeline import (
+        MoEPipeArgs,
+        build_graph,
+        host_buffer_names,
+        make_pipe_buffers,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    if args.smoke:
+        margs = MoEPipeArgs(n_experts=4, tokens=32, d_model=8, d_ff=16,
+                            n_chunks=2)
+    else:
+        margs = MoEPipeArgs(tokens=args.moe_tokens)
+    bufs, _, cap = make_pipe_buffers(margs, seed=0, with_expected=False)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names(margs))
+    impl_choice = not args.smoke  # same rationale as build_halo
+    g = build_graph(margs, cap, impl_choice=impl_choice)
+    return g, jbufs, metric_for("moe", args), (margs, cap)
+
+
 def build_attn(args):
     import jax.numpy as jnp
 
@@ -150,7 +178,10 @@ def build_attn(args):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny CPU config")
-    ap.add_argument("--workload", choices=("halo", "spmv", "attn"), default="halo")
+    ap.add_argument("--workload", choices=("halo", "spmv", "attn", "moe"),
+                    default="halo")
+    ap.add_argument("--moe-tokens", type=int, default=8192,
+                    help="total tokens (moe)")
     ap.add_argument("--m", type=int, default=None, help="matrix rows (spmv)")
     ap.add_argument("--halo-n", type=int, default=512, help="cells per side (halo)")
     ap.add_argument("--mcts-iters", type=int, default=24, help="MCTS iterations (compile budget)")
@@ -193,7 +224,8 @@ def main() -> int:
     from tenzing_tpu.solve.mcts import MctsOpts, explore
     from tenzing_tpu.solve.mcts.strategies import FastMin
 
-    build = {"halo": build_halo, "spmv": build_spmv, "attn": build_attn}[args.workload]
+    build = {"halo": build_halo, "spmv": build_spmv, "attn": build_attn,
+             "moe": build_moe}[args.workload]
     built = build(args)
     g, bufs, metric = built[0], built[1], built[2]
     plat = Platform.make_n_lanes(2)
@@ -209,6 +241,10 @@ def main() -> int:
         from tenzing_tpu.models.halo_pipeline import naive_order
 
         naive_seq = naive_order(built[3], naive_plat)
+    elif args.workload == "moe":
+        from tenzing_tpu.models.moe_pipeline import naive_order
+
+        naive_seq = naive_order(built[3][0], built[3][1], naive_plat)
     else:
         naive_state = State(g)
         while not naive_state.is_terminal():
@@ -223,11 +259,17 @@ def main() -> int:
     # discipline — the one the reference's graph hard-codes via its
     # every-post-before-any-wait edges (ops_halo_exchange.cu:249-256)
     incumbents = []
-    if args.workload == "halo":
-        from tenzing_tpu.models.halo_pipeline import greedy_overlap_order
+    if args.workload in ("halo", "moe"):
         from tenzing_tpu.solve.mcts.mcts import SimResult
 
-        greedy_seq = greedy_overlap_order(built[3], plat)
+        if args.workload == "halo":
+            from tenzing_tpu.models.halo_pipeline import greedy_overlap_order
+
+            greedy_seq = greedy_overlap_order(built[3], plat)
+        else:
+            from tenzing_tpu.models.moe_pipeline import greedy_overlap_order
+
+            greedy_seq = greedy_overlap_order(built[3][0], built[3][1], plat)
         t0 = time.time()
         greedy = bench.benchmark(greedy_seq, opts)
         sys.stderr.write(

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""MCTS schedule search on the single-chip MoE dispatch/combine pipeline.
+
+The expert-parallel benchmark workload (models/moe_pipeline.py): routed tokens
+staged through async host round-trip DMAs to the resident experts, searched
+over order x lane x expert-kernel across independent microbatch chunk chains.
+Follows the reference per-workload driver shape
+(tenzing-mcts/examples/spmv_run_strategy.cuh) with ``--strategy`` selecting
+the search strategy.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from examples import _driver
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    _driver.add_common_args(ap)
+    _driver.add_mcts_args(ap)
+    ap.add_argument("--tokens", type=int, default=8192)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--chunks", type=int, default=4)
+    ap.add_argument("--no-impl-choice", action="store_true",
+                    help="drop the XLA-vs-Pallas expert kernel menu")
+    args = ap.parse_args()
+    _driver.setup(args)
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.moe_pipeline import (
+        MoEPipeArgs,
+        build_graph,
+        host_buffer_names,
+        make_pipe_buffers,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
+
+    margs = MoEPipeArgs(n_experts=args.experts, tokens=args.tokens,
+                        d_model=args.d_model, d_ff=args.d_ff,
+                        n_chunks=args.chunks)
+    bufs, _, cap = make_pipe_buffers(margs, seed=args.seed, with_expected=False)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names(margs))
+    g = build_graph(margs, cap, impl_choice=not args.no_impl_choice)
+    plat = Platform.make_n_lanes(args.lanes)
+    bench = EmpiricalBenchmarker(TraceExecutor(plat, jbufs))
+    res = explore(
+        g,
+        plat,
+        bench,
+        MctsOpts(
+            n_iters=args.mcts_iters,
+            bench_opts=BenchOpts(n_iters=args.benchmark_iters),
+            expand_rollout=not args.no_expand_rollout,
+            dump_tree=args.dump_tree,
+            seed=args.seed,
+        ),
+        strategy=getattr(strategies, args.strategy),
+    )
+    _driver.emit(res, args.dump_csv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

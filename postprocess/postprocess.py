@@ -127,7 +127,32 @@ def fit_rules(X: np.ndarray, classes: np.ndarray, feats: List[str], max_depth: i
     return export_text(clf, feature_names=feats)
 
 
-def analyze(text: str, max_depth: int = 3, stream=None) -> dict:
+def plot_classes(
+    sorted_times: np.ndarray, bounds: List[int], out_path: str
+) -> None:
+    """Sorted-pct10 curve with performance-class boundary markers — the
+    reference postprocess's matplotlib figure (its step-response/peak view),
+    saved to ``out_path``."""
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 4))
+    ax.plot(np.arange(len(sorted_times)), sorted_times * 1e3, lw=1.5)
+    for b in bounds:
+        ax.axvline(b - 0.5, ls="--", lw=1)
+    ax.set_xlabel("schedule (sorted by pct10)")
+    ax.set_ylabel("pct10 iteration time [ms]")
+    ax.set_title(
+        f"{len(sorted_times)} schedules, {len(bounds) + 1} performance classes"
+    )
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+
+
+def analyze(text: str, max_depth: int = 3, stream=None, plot_path=None) -> dict:
     stream = stream or sys.stdout
     rows = load_rows(text)
     if not rows:
@@ -159,6 +184,9 @@ def analyze(text: str, max_depth: int = 3, stream=None) -> dict:
         rules = fit_rules(X, classes, feats, max_depth)
         stream.write("design rules (decision tree over schedule features):\n")
         stream.write(rules)
+    if plot_path:
+        plot_classes(sorted_times, bounds, plot_path)
+        stream.write(f"figure: {plot_path}\n")
     return {"n": len(rows), "boundaries": bounds, "classes": classes.tolist(), "rules": rules}
 
 
@@ -166,9 +194,11 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("csv", help="solver result database (pipe-delimited)")
     ap.add_argument("--max-depth", type=int, default=3)
+    ap.add_argument("--plot", default=None, metavar="PNG",
+                    help="save the sorted-pct10 class figure here")
     args = ap.parse_args()
     with open(args.csv) as f:
-        analyze(f.read(), args.max_depth)
+        analyze(f.read(), args.max_depth, plot_path=args.plot)
     return 0
 
 

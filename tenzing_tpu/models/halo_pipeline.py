@@ -181,58 +181,14 @@ def greedy_overlap_order(args: HaloArgs, platform) -> Sequence:
     with its every-post-before-any-wait edges (ops_halo_exchange.cu:249-256);
     here the graph leaves the order free and this incumbent seeds the anytime
     search with it: packs round-robin across lanes, every transfer posted
-    before any await, unpacks last."""
-    from tenzing_tpu.core.state import AssignLane, ExecuteOp, State
-    from tenzing_tpu.core.sync_ops import SyncOp
+    before any await, unpacks last (solve/greedy.py)."""
+    from tenzing_tpu.solve.greedy import greedy_phase_order
 
-    phases = ("start", "pack", "spill", "fetch", "await", "unpack", "finish")
-
-    def phase(op) -> int:
-        name = op.name()
-        for i, p in enumerate(phases):
-            if name.startswith(p):
-                return i
-        return 0  # sync ops: only reachable via the fallback branch below
-
-    st = State(build_graph(args))
-    lane_rr = 0
-    while not st.is_terminal():
-        ds = st.get_decisions(platform)
-        assigns = sorted(
-            (d for d in ds if isinstance(d, AssignLane)), key=lambda d: d.op.name()
-        )
-        if assigns:
-            # round-robin the alphabetically-first unassigned op onto lanes
-            opname = assigns[0].op.name()
-            lane = platform.lanes[lane_rr % len(platform.lanes)]
-            lane_rr += 1
-            d = next(
-                d for d in assigns if d.op.name() == opname and d.lane == lane
-            )
-            st = st.apply(d)
-            continue
-        execs = [d for d in ds if isinstance(d, ExecuteOp)]
-        real = sorted(
-            (d for d in execs if not isinstance(d.op, SyncOp)),
-            key=lambda d: (phase(d.op), d.op.name()),
-        )
-        syncs = sorted(
-            (d for d in execs if isinstance(d.op, SyncOp)), key=lambda d: d.op.desc()
-        )
-        # never run a later-phase op while an earlier-phase op anywhere in the
-        # graph is still unexecuted (it is gated behind one of the offered
-        # syncs): place the sync instead, keeping every post ahead of every
-        # await across *all* lanes
-        done = {op.name() for op in st.sequence}
-        pending_min = min(
-            (phase(v) for v in st.graph.vertices() if v.name() not in done),
-            default=99,
-        )
-        if real and (not syncs or phase(real[0].op) <= pending_min):
-            st = st.apply(real[0])
-            continue
-        st = st.apply(syncs[0])
-    return st.sequence
+    return greedy_phase_order(
+        build_graph(args),
+        platform,
+        ("start", "pack", "spill", "fetch", "await", "unpack", "finish"),
+    )
 
 
 def _padded_shape(shape: Tuple[int, int, int, int]) -> Tuple[int, int, int, int]:

@@ -68,6 +68,19 @@ class MoEArgs:
 from tenzing_tpu.utils.numeric import gelu_tanh as _gelu
 
 
+def top1_route(x: np.ndarray, wg: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-1 gating in float64: (expert index, softmax gate weight) per token
+    — the single source of the routing rule for every MoE buffer builder
+    (multi-chip here, single-chip models/moe_pipeline.py) and its expected-Y
+    host references."""
+    logits = x.astype(np.float64) @ wg.astype(np.float64)  # (T, E)
+    expert = np.argmax(logits, axis=1)
+    pz = np.exp(logits - logits.max(axis=1, keepdims=True))
+    pz /= pz.sum(axis=1, keepdims=True)
+    gate = pz[np.arange(len(x)), expert]
+    return expert, gate
+
+
 class DispatchPack(DeviceOp):
     """Fill chunk ``c``'s capacity-padded send buffer from the local tokens the
     router assigned to each expert (the gather the reference's Scatter op does
@@ -260,11 +273,7 @@ def make_moe_buffers(
     w2 = rng.standard_normal((n, dff, d)).astype(dt) / np.sqrt(dff)
 
     # host routing: top-1 expert + softmax gate weight per token
-    logits = x.astype(np.float64) @ wg.astype(np.float64)  # (n*t, n)
-    expert = np.argmax(logits, axis=1)
-    pz = np.exp(logits - logits.max(axis=1, keepdims=True))
-    pz /= pz.sum(axis=1, keepdims=True)
-    gate = pz[np.arange(n * t), expert]  # (n*t,)
+    expert, gate = top1_route(x, wg)
 
     # capacity: max tokens any (shard, chunk) sends to any expert
     cap = 1

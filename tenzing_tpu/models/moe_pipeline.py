@@ -1,0 +1,332 @@
+"""Single-chip MoE dispatch/combine pipeline: a benchmark workload.
+
+The multi-chip MoE layer (models/moe.py) moves routed tokens between expert
+shards with all-to-alls.  The environment benches on ONE chip, so — exactly
+like the halo pipeline (models/halo_pipeline.py) — the network hop is realized
+as the chip's asynchronous host round-trip DMA (``HostSpillStart`` ->
+``HostFetchStart``): routed tokens travel device -> pinned-host -> device to
+the resident experts and their outputs travel back the same way, the
+single-chip analog of an expert-parallel deployment's dispatch and combine
+transfers.  Numerically this is the 1-shard degenerate case: all experts are
+resident, so Y must equal the dense routed evaluation regardless of schedule.
+
+Per microbatch chunk ``c`` the DAG is::
+
+    pack_c (DeviceOp, lane-searched)   # gather routed tokens into slot table
+      -> spilld_c -> fetchd_c -> awaitd_c   # dispatch round trip (post/wait)
+      -> ffn_c (DeviceOp / ChoiceOp)        # per-expert gelu MLP (MXU)
+      -> spillc_c -> fetchc_c -> awaitc_c   # combine round trip (post/wait)
+      -> combine_c (DeviceOp, lane-searched)  # weighted scatter-add
+    all combine_c -> concat -> finish
+
+The ``n_chunks`` chains are independent: the searched freedom is how chunk
+A's DMAs hide behind chunk B's expert compute and how the two DMA directions
+pipeline — the schedule MoE systems hand-tune.  The routing is host-side
+setup (top-1 gating into capacity-padded slot tables, the negotiation analog
+of models/moe.py), and staged transfers use the (rows, 128) flat layout the
+host-offload path is reliable for (see models/halo_pipeline.PackFlat).
+
+With ``impl_choice=True`` the expert MLP becomes a ChoiceOp over XLA einsums
+vs the Pallas per-expert kernel (ops/ffn_pallas.py ffn_pallas_batched).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import (
+    ChoiceOp,
+    CompoundOp,
+    DeviceOp,
+    Finish,
+    OpBase,
+    Start,
+)
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.models.halo_pipeline import flatten_face, unflatten_face
+from tenzing_tpu.ops.comm_ops import AwaitTransfer, HostFetchStart, HostSpillStart
+from tenzing_tpu.utils.numeric import gelu_tanh
+
+
+@dataclass(frozen=True)
+class MoEPipeArgs:
+    n_experts: int = 8
+    tokens: int = 8192  # total tokens on the chip
+    d_model: int = 512
+    d_ff: int = 2048
+    n_chunks: int = 4  # independent dispatch->expert->combine chains
+    dtype: str = "float32"
+
+    @property
+    def chunk_tokens(self) -> int:
+        assert self.tokens % self.n_chunks == 0
+        return self.tokens // self.n_chunks
+
+
+def _slot_shape(args: MoEPipeArgs, cap: int) -> Tuple[int, int, int]:
+    return (args.n_experts, cap, args.d_model)
+
+
+class DispatchPackPipe(DeviceOp):
+    """Gather chunk ``c``'s routed tokens into the capacity-padded slot table
+    and emit it in the (rows, 128) staging layout the host round trip needs."""
+
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+        super().__init__(name)
+        self._c, self._args, self._cap = c, args, cap
+
+    def reads(self):
+        return ["X", f"idx_{self._c}"]
+
+    def writes(self):
+        return [f"send_{self._c}"]
+
+    def apply(self, bufs, ctx):
+        a, tc_ = self._args, self._args.chunk_tokens
+        xc = bufs["X"][self._c * tc_ : (self._c + 1) * tc_]  # (Tc, d)
+        slots = xc[bufs[f"idx_{self._c}"]]  # (E, C, d)
+        return {f"send_{self._c}": flatten_face(slots, _slot_shape(a, self._cap))}
+
+
+class ExpertFFNPipe(DeviceOp):
+    """Run every resident expert's gelu MLP over its received slots (the MXU
+    compute the DMAs hide behind)."""
+
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+        super().__init__(name)
+        self._c, self._args, self._cap = c, args, cap
+
+    def reads(self):
+        return [f"recv_{self._c}", "W1", "W2"]
+
+    def writes(self):
+        return [f"out_{self._c}"]
+
+    def _mlp(self, x3, w1, w2):
+        import jax
+        import jax.numpy as jnp
+
+        h = jax.nn.gelu(
+            jnp.einsum("ecd,edf->ecf", x3, w1, preferred_element_type=jnp.float32)
+        )
+        return jnp.einsum(
+            "ecf,efd->ecd", h.astype(x3.dtype), w2,
+            preferred_element_type=jnp.float32,
+        )
+
+    def apply(self, bufs, ctx):
+        shape = _slot_shape(self._args, self._cap)
+        x3 = unflatten_face(bufs[f"recv_{self._c}"], shape)
+        y = self._mlp(x3, bufs["W1"], bufs["W2"]).astype(x3.dtype)
+        return {f"out_{self._c}": flatten_face(y, shape)}
+
+
+class ExpertFFNPipePallas(ExpertFFNPipe):
+    """Same per-expert MLP through the Pallas kernel (one expert's weight pair
+    + one row tile per program in VMEM)."""
+
+    def _mlp(self, x3, w1, w2):
+        from tenzing_tpu.ops.ffn_pallas import ffn_pallas_batched
+
+        return ffn_pallas_batched(x3, w1, w2)
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
+class ExpertFFNPipeChoice(ChoiceOp):
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+        super().__init__(name)
+        self._c, self._args, self._cap = c, args, cap
+
+    def choices(self) -> List[OpBase]:
+        return [
+            ExpertFFNPipe(self.name() + ".xla", self._c, self._args, self._cap),
+            ExpertFFNPipePallas(
+                self.name() + ".pallas", self._c, self._args, self._cap
+            ),
+        ]
+
+
+class CombinePipe(DeviceOp):
+    """Scatter-add the returned expert outputs into token order scaled by the
+    gate weights (padding slots carry weight 0)."""
+
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+        super().__init__(name)
+        self._c, self._args, self._cap = c, args, cap
+
+    def reads(self):
+        return [f"ret_{self._c}", f"idx_{self._c}", f"w_{self._c}"]
+
+    def writes(self):
+        return [f"Y_{self._c}"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        a = self._args
+        vals = unflatten_face(bufs[f"ret_{self._c}"], _slot_shape(a, self._cap))
+        idx = bufs[f"idx_{self._c}"].reshape(-1)
+        w = bufs[f"w_{self._c}"].reshape(-1, 1)
+        y = jnp.zeros((a.chunk_tokens, a.d_model), vals.dtype)
+        return {f"Y_{self._c}": y.at[idx].add(w * vals.reshape(-1, a.d_model))}
+
+
+class ConcatPipe(DeviceOp):
+    def __init__(self, name: str, args: MoEPipeArgs):
+        super().__init__(name)
+        self._args = args
+
+    def reads(self):
+        return [f"Y_{c}" for c in range(self._args.n_chunks)]
+
+    def writes(self):
+        return ["Y"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        return {
+            "Y": jnp.concatenate(
+                [bufs[f"Y_{c}"] for c in range(self._args.n_chunks)], axis=0
+            )
+        }
+
+
+def chunk_ops(args: MoEPipeArgs, c: int, cap: int, impl_choice: bool = False):
+    """The 9-op chain for one microbatch chunk."""
+    mk = ExpertFFNPipeChoice if impl_choice else ExpertFFNPipe
+    pack = DispatchPackPipe(f"pack_{c}", c, args, cap)
+    spilld = HostSpillStart(f"spilld_{c}", f"send_{c}", f"hdisp_{c}")
+    fetchd = HostFetchStart(f"fetchd_{c}", f"hdisp_{c}", f"recv_{c}")
+    awaitd = AwaitTransfer(f"awaitd_{c}", f"recv_{c}")
+    ffn = mk(f"ffn_{c}", c, args, cap)
+    spillc = HostSpillStart(f"spillc_{c}", f"out_{c}", f"hcomb_{c}")
+    fetchc = HostFetchStart(f"fetchc_{c}", f"hcomb_{c}", f"ret_{c}")
+    awaitc = AwaitTransfer(f"awaitc_{c}", f"ret_{c}")
+    comb = CombinePipe(f"combine_{c}", c, args, cap)
+    return pack, spilld, fetchd, awaitd, ffn, spillc, fetchc, awaitc, comb
+
+
+PHASES = ("start", "pack", "spilld", "fetchd", "awaitd", "ffn", "spillc",
+          "fetchc", "awaitc", "combine", "concat", "finish")
+
+
+def build_graph(args: MoEPipeArgs, cap: int, impl_choice: bool = False) -> Graph:
+    """``n_chunks`` independent chains joined by the final concat (the
+    multi-chip MoELayer's shape with the all-to-alls replaced by host round
+    trips)."""
+    g = Graph()
+    cat = ConcatPipe("concat", args)
+    for c in range(args.n_chunks):
+        ops = chunk_ops(args, c, cap, impl_choice)
+        g.start_then(ops[0])
+        for a, b in zip(ops, ops[1:]):
+            g.then(a, b)
+        g.then(ops[-1], cat)
+    g.then_finish(cat)
+    return g
+
+
+def naive_order(args: MoEPipeArgs, cap: int, platform) -> Sequence:
+    """The naive sequential baseline: one lane, each chunk's chain completed
+    (posts immediately awaited) before the next starts."""
+    lane = platform.lanes[0]
+    ops: List = [Start()]
+    for c in range(args.n_chunks):
+        for op in chunk_ops(args, c, cap):
+            ops.append(op.bind(lane) if isinstance(op, DeviceOp) else op)
+    cat = ConcatPipe("concat", args)
+    ops += [cat.bind(lane), Finish()]
+    return Sequence(ops)
+
+
+def greedy_overlap_order(args: MoEPipeArgs, cap: int, platform) -> Sequence:
+    """Phase-ordered incumbent: all packs, all dispatch posts, ... — the
+    software-pipelined discipline, via the shared greedy (solve/greedy.py)."""
+    from tenzing_tpu.solve.greedy import greedy_phase_order
+
+    return greedy_phase_order(build_graph(args, cap), platform, PHASES)
+
+
+def route_tokens(
+    x: np.ndarray, wg: np.ndarray, args: MoEPipeArgs
+) -> Tuple[int, Dict[str, np.ndarray]]:
+    """Host-side top-1 routing into per-chunk capacity-padded slot tables
+    (idx_{c} (E, C) int32, w_{c} (E, C) float32) — the setup-negotiation
+    analog (models/moe.py, reference row_part_spmv.cuh:259-423).  Returns
+    (capacity, tables); the (expert, gate) assignment comes from the shared
+    :func:`~tenzing_tpu.models.moe.top1_route` rule."""
+    from tenzing_tpu.models.moe import top1_route
+
+    e_, tc_ = args.n_experts, args.chunk_tokens
+    expert, gate = top1_route(x, wg)
+
+    cap = 1
+    for c in range(args.n_chunks):
+        e_blk = expert[c * tc_ : (c + 1) * tc_]
+        cap = max(cap, int(np.bincount(e_blk, minlength=e_).max()))
+    tables: Dict[str, np.ndarray] = {}
+    for c in range(args.n_chunks):
+        idx = np.zeros((e_, cap), dtype=np.int32)
+        w = np.zeros((e_, cap), dtype=np.dtype(args.dtype))
+        fill = [0] * e_
+        for j in range(tc_):
+            e = int(expert[c * tc_ + j])
+            idx[e, fill[e]] = j
+            w[e, fill[e]] = gate[c * tc_ + j]
+            fill[e] += 1
+        tables[f"idx_{c}"] = idx
+        tables[f"w_{c}"] = w
+    return cap, tables
+
+
+def make_pipe_buffers(
+    args: MoEPipeArgs, seed: int = 0, with_expected: bool = True
+) -> Tuple[Dict[str, np.ndarray], Optional[np.ndarray], int]:
+    """(buffers, expected Y or None, capacity).  Routing runs here on the
+    host; the expected Y is the dense routed evaluation in float64."""
+    rng = np.random.default_rng(seed)
+    e_, t, d, dff = args.n_experts, args.tokens, args.d_model, args.d_ff
+    dt = np.dtype(args.dtype)
+    x = rng.standard_normal((t, d)).astype(dt)
+    wg = rng.standard_normal((d, e_)).astype(dt)
+    w1 = (rng.standard_normal((e_, d, dff)) / np.sqrt(d)).astype(dt)
+    w2 = (rng.standard_normal((e_, dff, d)) / np.sqrt(dff)).astype(dt)
+    cap, tables = route_tokens(x, wg, args)
+
+    bufs: Dict[str, np.ndarray] = {"X": x, "W1": w1, "W2": w2,
+                                   "Y": np.zeros((t, d), dt)}
+    bufs.update(tables)
+    rows = -(-int(np.prod(_slot_shape(args, cap))) // 128)
+    flat = np.zeros((rows, 128), dt)
+    for c in range(args.n_chunks):
+        for nm in (f"send_{c}", f"hdisp_{c}", f"recv_{c}", f"out_{c}",
+                   f"hcomb_{c}", f"ret_{c}"):
+            bufs[nm] = flat.copy()
+        bufs[f"Y_{c}"] = np.zeros((args.chunk_tokens, d), dt)
+
+    want = None
+    if with_expected:
+        from tenzing_tpu.models.moe import top1_route
+
+        expert, gate = top1_route(x, wg)
+        want64 = np.zeros((t, d), np.float64)
+        for e in range(e_):
+            sel = expert == e
+            h = gelu_tanh(x[sel].astype(np.float64) @ w1[e].astype(np.float64))
+            want64[sel] = gate[sel, None] * (h @ w2[e].astype(np.float64))
+        want = want64.astype(np.float32)
+    return bufs, want, cap
+
+
+def host_buffer_names(args: MoEPipeArgs) -> List[str]:
+    """Buffers the caller must device_put into pinned_host."""
+    return [f"hdisp_{c}" for c in range(args.n_chunks)] + [
+        f"hcomb_{c}" for c in range(args.n_chunks)
+    ]

@@ -68,3 +68,72 @@ def ffn_pallas(
         interpret=interpret,
     )(x, w1, w2)
     return out[:n] if pad else out
+
+
+def _ffn_batched_kernel(x_ref, w1_ref, w2_ref, y_out):
+    k = pl.program_id(2)
+    x = x_ref[0]  # (bm, d) one expert's row tile
+    h = jax.nn.gelu(
+        jnp.dot(x, w1_ref[0], preferred_element_type=jnp.float32)
+    )
+    contrib = jnp.dot(
+        h.astype(x.dtype), w2_ref[0], preferred_element_type=jnp.float32
+    ).astype(y_out.dtype)
+
+    @pl.when(k == 0)
+    def _init():
+        y_out[0] = contrib
+
+    @pl.when(k != 0)
+    def _accum():
+        y_out[0] += contrib
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ffn_pallas_batched(
+    x: jax.Array,
+    w1: jax.Array,
+    w2: jax.Array,
+    *,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Per-expert gelu MLP: x (E, C, d), w1 (E, d, dff), w2 (E, dff, d) ->
+    (E, C, d), expert e's rows through expert e's weights (the MoE expert
+    kernel, models/moe_pipeline.py).
+
+    The grid runs over (expert, row tile, hidden tile): gelu is elementwise,
+    so y = sum_k gelu(x @ W1[:, k-th cols]) @ W2[k-th rows, :] decomposes over
+    hidden-dim tiles and each program holds one row tile plus one (d, bf) /
+    (bf, d) weight-tile pair in VMEM — a whole 512x2048 expert pair plus its
+    hidden activations exceeds the 16 MB VMEM scope (measured on v5e).  The
+    hidden tile k is the innermost grid axis, so the output block is revisited
+    consecutively and accumulated in place."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    e, c, d = x.shape
+    dff = w1.shape[2]
+    bm = min(c, 256)
+    pad = (-c) % bm
+    cp = c + pad
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    bf = min(dff, 512)
+    fpad = (-dff) % bf
+    if fpad:
+        # zero-padding the hidden dim is exact: gelu(x @ 0) = gelu(0) row
+        # through zero W2 rows contributes 0
+        w1 = jnp.pad(w1, ((0, 0), (0, 0), (0, fpad)))
+        w2 = jnp.pad(w2, ((0, 0), (0, fpad), (0, 0)))
+    out = pl.pallas_call(
+        _ffn_batched_kernel,
+        grid=(e, cp // bm, (dff + fpad) // bf),
+        in_specs=[
+            pl.BlockSpec((1, bm, d), lambda i, j, k: (i, j, 0)),
+            pl.BlockSpec((1, d, bf), lambda i, j, k: (i, 0, k)),
+            pl.BlockSpec((1, bf, d), lambda i, j, k: (i, k, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bm, d), lambda i, j, k: (i, j, 0)),
+        out_shape=out_struct((e, cp, d), x.dtype, x, w1, w2),
+        interpret=interpret,
+    )(x, w1, w2)
+    return out[:, :c] if pad else out

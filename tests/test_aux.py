@@ -12,6 +12,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from tenzing_tpu.utils import initgate, reproduce
 
@@ -88,6 +89,17 @@ def test_class_boundaries_flat_is_one_class():
     assert class_boundaries(np.full(100, 3.0)) == []
 
 
+def test_postprocess_plot_writes_figure(tmp_path):
+    """--plot saves the sorted-pct10 class figure (the reference postprocess's
+    matplotlib output)."""
+    pytest.importorskip("matplotlib")
+    from postprocess.postprocess import plot_classes
+
+    out = str(tmp_path / "classes.png")
+    plot_classes(np.sort(np.random.default_rng(0).random(20)), [7, 13], out)
+    assert os.path.getsize(out) > 1000
+
+
 def test_example_spmv_dfs_smoke():
     """Tiny end-to-end run of the DFS example CLI on CPU (reference CI runs
     build + CPU subset only, SURVEY.md §4)."""
@@ -106,6 +118,17 @@ def test_example_spmv_mcts_smoke():
     p = subprocess.run(
         [sys.executable, "examples/spmv_mcts.py", "--cpu", "--matrix-m", "64",
          "--mcts-iters", "3", "--benchmark-iters", "3", "--strategy", "Coverage"],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+    )
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.strip()
+
+
+def test_example_moe_mcts_smoke():
+    p = subprocess.run(
+        [sys.executable, "examples/moe_mcts.py", "--cpu", "--tokens", "32",
+         "--experts", "4", "--d-model", "8", "--d-ff", "16", "--chunks", "2",
+         "--no-impl-choice", "--mcts-iters", "3", "--benchmark-iters", "3"],
         capture_output=True, text=True, cwd=REPO, timeout=600,
     )
     assert p.returncode == 0, p.stderr

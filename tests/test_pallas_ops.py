@@ -105,3 +105,41 @@ class TestImplChoiceSearch:
         for sched in (pallas_scheds[0], xla_scheds[0]):
             out = ex.run(sched.sequence)
             np.testing.assert_allclose(np.asarray(out["y"]), want, rtol=2e-3)
+
+
+class TestFfnPallas:
+    def test_single_matches_xla(self):
+        import jax
+
+        from tenzing_tpu.ops.ffn_pallas import ffn_pallas
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((37, 8)).astype(np.float32)  # ragged rows
+        w1 = rng.standard_normal((8, 16)).astype(np.float32)
+        w2 = rng.standard_normal((16, 8)).astype(np.float32)
+        want = jax.nn.gelu(x @ w1) @ w2
+        got = ffn_pallas(jnp.asarray(x), jnp.asarray(w1), jnp.asarray(w2),
+                         interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_batched_tiles_hidden_dim(self):
+        """Ragged rows AND a hidden dim that is not a multiple of the tile:
+        the zero-padded hidden tiles must contribute exactly 0."""
+        import jax
+
+        from tenzing_tpu.ops.ffn_pallas import ffn_pallas_batched
+
+        rng = np.random.default_rng(1)
+        # dff=520 > the 512 hidden tile: two k-tiles, the second zero-padded
+        # by 504 — exercises both the in-place accumulation and the padding
+        e, c, d, dff = 2, 11, 8, 520
+        x = rng.standard_normal((e, c, d)).astype(np.float32)
+        w1 = rng.standard_normal((e, d, dff)).astype(np.float32)
+        w2 = rng.standard_normal((e, dff, d)).astype(np.float32)
+        want = np.stack([
+            np.asarray(jax.nn.gelu(x[i] @ w1[i]) @ w2[i]) for i in range(e)
+        ])
+        got = ffn_pallas_batched(jnp.asarray(x), jnp.asarray(w1),
+                                 jnp.asarray(w2), interpret=True)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
