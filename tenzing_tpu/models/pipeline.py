@@ -168,6 +168,55 @@ class InterleaveY(DeviceOp):
         return {"Y": chains.reshape(mv * v, b, d)}
 
 
+def _forward_chain(
+    g: Graph,
+    v: int,
+    a: PipelineArgs,
+    make_compute,
+    inject_prefix: str = "inject",
+    rotate_prefix: str = "rotate",
+    await_prefix: str = "await",
+    with_collect: bool = True,
+):
+    """Wire one chain's forward tick chain — inject (while microbatches
+    remain) -> compute -> rotate-post -> await -> next tick — shared by the
+    forward-only Pipeline and the PipelineTrain compounds.  Returns
+    (last compute op, last collect op or None)."""
+    mv, ticks = a.chain_microbatches, a.chain_ticks
+    prev_entry = None  # the op that delivers tick t's activation
+    prev_collect = None
+    comp = None
+    for t in range(ticks):
+        comp = make_compute(v, t)
+        if t < mv:
+            inj = Inject(f"{inject_prefix}_{v}_{t}", v, t)
+            if prev_entry is None:
+                g.start_then(inj)
+            else:
+                g.then(prev_entry, inj)
+            g.then(inj, comp)
+        else:
+            g.then(prev_entry, comp)
+        if prev_collect is not None:
+            # WAR: compute_t overwrites out_v that collect_{t-1} read
+            g.then(prev_collect, comp)
+        if t < ticks - 1:
+            post = PermuteStart(
+                f"{rotate_prefix}_{v}_{t}", f"out_{v}", _act(v, t + 1), AXIS
+            )
+            await_ = AwaitTransfer(f"{await_prefix}_{v}_{t}", _act(v, t + 1))
+            g.then(comp, post)
+            g.then(post, await_)
+            prev_entry = await_
+        if with_collect and t >= a.n_pp - 1:
+            col = Collect(f"collect_{v}_{t}", v, t, a)
+            g.then(comp, col)
+            if prev_collect is not None:
+                g.then(prev_collect, col)  # RAW: Y_v chain
+            prev_collect = col
+    return comp, prev_collect
+
+
 class Pipeline(CompoundOp):
     """The whole pipelined forward as one compound op: ``n_chains``
     independent tick chains, each with the post/wait-split rotate, joined by
@@ -185,40 +234,266 @@ class Pipeline(CompoundOp):
         g = Graph()
         inter = InterleaveY("pp_interleave", a)
         for v in range(a.n_chains):
-            mv, ticks = a.chain_microbatches, a.chain_ticks
-            prev_entry = None  # the op that delivers tick t's activation
-            prev_collect = None
-            for t in range(ticks):
-                comp = StageCompute(f"compute_{v}_{t}", v, t)
-                if t < mv:
-                    inj = Inject(f"inject_{v}_{t}", v, t)
-                    if prev_entry is None:
-                        g.start_then(inj)
-                    else:
-                        g.then(prev_entry, inj)
-                    g.then(inj, comp)
-                else:
-                    g.then(prev_entry, comp)
-                if prev_collect is not None:
-                    # WAR: compute_t overwrites out_v that collect_{t-1} read
-                    g.then(prev_collect, comp)
-                if t < ticks - 1:
-                    post = PermuteStart(
-                        f"rotate_{v}_{t}", f"out_{v}", _act(v, t + 1), AXIS
-                    )
-                    await_ = AwaitTransfer(f"await_{v}_{t}", _act(v, t + 1))
-                    g.then(comp, post)
-                    g.then(post, await_)
-                    prev_entry = await_
-                if t >= a.n_pp - 1:
-                    col = Collect(f"collect_{v}_{t}", v, t, a)
-                    g.then(comp, col)
-                    if prev_collect is not None:
-                        g.then(prev_collect, col)  # RAW: Y_v chain
-                    prev_collect = col
-            g.then(prev_collect, inter)
+            _comp, last_collect = _forward_chain(
+                g, v, a, lambda vv, tt: StageCompute(f"compute_{vv}_{tt}", vv, tt)
+            )
+            g.then(last_collect, inter)
         g.then_finish(inter)
         return g
+
+
+class TrainForward(DeviceOp):
+    """Forward stage compute that also stashes this microbatch's input
+    activation and pre-activation for the backward pass (the per-device
+    activation memory a pipeline training step carries)."""
+
+    def __init__(self, name: str, v: int, t: int, args: PipelineArgs):
+        super().__init__(name)
+        self._v, self._t, self._args = v, t, args
+
+    def reads(self):
+        return [_act(self._v, self._t), "W",
+                f"stash_a_{self._v}", f"stash_z_{self._v}"]
+
+    def writes(self):
+        return [f"out_{self._v}", f"stash_a_{self._v}", f"stash_z_{self._v}"]
+
+    def apply(self, bufs, ctx):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        a = self._args
+        p = lax.axis_index(AXIS)
+        m = self._t - p  # this shard's live microbatch (may be out of range)
+        valid = (m >= 0) & (m < a.chain_microbatches)
+        idx = jnp.clip(m, 0, a.chain_microbatches - 1)
+        act = bufs[_act(self._v, self._t)]  # (B, d)
+        z = jnp.dot(act, bufs["W"][0], preferred_element_type=jnp.float32)
+        z = z.astype(act.dtype)
+        out = jax.nn.gelu(z)
+
+        def stash(old, val):
+            upd = lax.dynamic_update_slice_in_dim(old, val[None], idx, 0)
+            return jnp.where(valid, upd, old)
+
+        return {
+            f"out_{self._v}": out,
+            f"stash_a_{self._v}": stash(bufs[f"stash_a_{self._v}"], act),
+            f"stash_z_{self._v}": stash(bufs[f"stash_z_{self._v}"], z),
+        }
+
+
+class BwdInject(DeviceOp):
+    """Backward tick ``u`` < M_v: the last stage seeds microbatch ``u``'s
+    gradient g = y - target (L2 loss; y recomputed from the stashed
+    pre-activation).  Other stages keep what the reverse rotate delivered."""
+
+    def __init__(self, name: str, v: int, u: int, args: PipelineArgs):
+        super().__init__(name)
+        self._v, self._u, self._args = v, u, args
+
+    def reads(self):
+        return [_act(self._v, self._u) + "g", f"stash_z_{self._v}",
+                f"target_{self._v}"]
+
+    def writes(self):
+        return [_act(self._v, self._u) + "g"]
+
+    def apply(self, bufs, ctx):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        a = self._args
+        p = lax.axis_index(AXIS)
+        y = jax.nn.gelu(bufs[f"stash_z_{self._v}"][self._u])
+        seed = y - bufs[f"target_{self._v}"][self._u]
+        g = bufs[_act(self._v, self._u) + "g"]
+        return {_act(self._v, self._u) + "g": jnp.where(p == a.n_pp - 1, seed, g)}
+
+
+class BwdCompute(DeviceOp):
+    """One backward stage step: dz = g * gelu'(z), dW += a^T dz (masked to
+    ticks where this shard holds a live microbatch), and the outgoing
+    gradient dz W^T for the reverse rotate."""
+
+    def __init__(self, name: str, v: int, u: int, args: PipelineArgs):
+        super().__init__(name)
+        self._v, self._u, self._args = v, u, args
+
+    def reads(self):
+        return [_act(self._v, self._u) + "g", "W", f"stash_a_{self._v}",
+                f"stash_z_{self._v}", f"dW_{self._v}"]
+
+    def writes(self):
+        return [f"gout_{self._v}", f"dW_{self._v}"]
+
+    def apply(self, bufs, ctx):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        a = self._args
+        p = lax.axis_index(AXIS)
+        m = self._u - (a.n_pp - 1 - p)
+        valid = (m >= 0) & (m < a.chain_microbatches)
+        idx = jnp.clip(m, 0, a.chain_microbatches - 1)
+        g = bufs[_act(self._v, self._u) + "g"]  # (B, d) incoming dL/dout
+        z = bufs[f"stash_z_{self._v}"][idx]
+        a_in = bufs[f"stash_a_{self._v}"][idx]
+        _, vjp = jax.vjp(jax.nn.gelu, z)
+        dz = vjp(g)[0]
+        w = bufs["W"][0]
+        dw = jnp.dot(a_in.T, dz, preferred_element_type=jnp.float32)
+        dw = jnp.where(valid, dw.astype(g.dtype), jnp.zeros_like(dw, g.dtype))
+        gout = jnp.dot(dz, w.T, preferred_element_type=jnp.float32)
+        return {
+            f"gout_{self._v}": gout.astype(g.dtype),
+            f"dW_{self._v}": bufs[f"dW_{self._v}"] + dw[None],
+        }
+
+
+class AddGrads(DeviceOp):
+    """Sum the per-chain weight-gradient accumulators (per-chain buffers keep
+    the chains' backward passes DAG-independent — a shared accumulator would
+    serialize them through SSA)."""
+
+    def __init__(self, name: str, args: PipelineArgs):
+        super().__init__(name)
+        self._args = args
+
+    def reads(self):
+        return [f"dW_{v}" for v in range(self._args.n_chains)]
+
+    def writes(self):
+        return ["dW"]
+
+    def apply(self, bufs, ctx):
+        out = bufs["dW_0"]
+        for v in range(1, self._args.n_chains):
+            out = out + bufs[f"dW_{v}"]
+        return {"dW": out}
+
+
+class PipelineTrain(CompoundOp):
+    """A FULL pipeline-parallel training step as one compound op: per chain,
+    the forward tick chain (with activation stashes), then the reverse-ring
+    backward chain seeding gradients at the last stage and accumulating dW
+    per stage; chains share nothing until the final gradient sum, so the
+    solver's order/lane freedom is the interleaved-1F1B question — chain A's
+    backward overlapping chain B's forward, with every rotate a post/wait
+    split the search places."""
+
+    def __init__(self, args: PipelineArgs, name: str = "pipeline_train"):
+        super().__init__(name)
+        self._args = args
+
+    def args(self) -> PipelineArgs:
+        return self._args
+
+    def graph(self) -> Graph:
+        a = self._args
+        g = Graph()
+        add = AddGrads("pt_addgrads", a)
+        for v in range(a.n_chains):
+            mv, ticks = a.chain_microbatches, a.chain_ticks
+            last_fwd, _ = _forward_chain(
+                g, v, a,
+                lambda vv, tt: TrainForward(f"fcompute_{vv}_{tt}", vv, tt, a),
+                inject_prefix="finject", rotate_prefix="frotate",
+                await_prefix="fawait", with_collect=False,
+            )
+            # backward: strictly after the chain's forward (the stashes are
+            # complete); other chains' forwards are free to overlap
+            prev_entry = last_fwd
+            for u in range(ticks):
+                bcomp = BwdCompute(f"bcompute_{v}_{u}", v, u, a)
+                if u < mv:
+                    binj = BwdInject(f"binject_{v}_{u}", v, u, a)
+                    g.then(prev_entry, binj)
+                    g.then(binj, bcomp)
+                else:
+                    g.then(prev_entry, bcomp)
+                if u < ticks - 1:
+                    post = PermuteStart(
+                        f"brotate_{v}_{u}", f"gout_{v}",
+                        _act(v, u + 1) + "g", AXIS, shift=-1,
+                    )
+                    await_ = AwaitTransfer(
+                        f"bawait_{v}_{u}", _act(v, u + 1) + "g"
+                    )
+                    g.then(bcomp, post)
+                    g.then(post, await_)
+                    prev_entry = await_
+            g.then(bcomp, add)
+        g.then_finish(add)
+        return g
+
+
+def make_train_buffers(
+    args: PipelineArgs, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
+    """(buffers, partition specs, expected dW) for the training step on a
+    1-D ``("pp",)`` mesh.  Expected dW is the host float64 backward of the
+    L2 loss 0.5*sum((stack(x_m) - target_m)^2) over every microbatch,
+    stacked per stage (shard p's block is stage p's gradient)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tenzing_tpu.utils.numeric import gelu_tanh, gelu_tanh_grad
+
+    rng = np.random.default_rng(seed)
+    s, m, v = args.n_pp, args.n_microbatches, args.n_chains
+    b, d = args.mb_size, args.d_model
+    mv = args.chain_microbatches
+    dt = np.dtype(args.dtype)
+    x = rng.standard_normal((m, b, d)).astype(dt)
+    w = rng.standard_normal((s, d, d)).astype(dt) / np.sqrt(d)
+    target = rng.standard_normal((m, b, d)).astype(dt)
+
+    # host float64 forward + backward
+    w64 = w.astype(np.float64)
+    dw = np.zeros((s, d, d), np.float64)
+    for mb in range(m):
+        acts, zs = [x[mb].astype(np.float64)], []
+        for st in range(s):
+            zs.append(acts[-1] @ w64[st])
+            acts.append(gelu_tanh(zs[-1]))
+        g = acts[-1] - target[mb].astype(np.float64)
+        for st in reversed(range(s)):
+            dz = g * gelu_tanh_grad(zs[st])
+            dw[st] += acts[st].T @ dz
+            g = dz @ w64[st].T
+
+    bufs: Dict[str, np.ndarray] = {
+        "W": w,
+        "dW": np.zeros((s, d, d), dt),
+    }
+    specs: Dict[str, object] = {
+        "W": P(AXIS, None, None),
+        "dW": P(AXIS, None, None),
+    }
+    for c in range(v):
+        bufs[f"X_{c}"] = x[c::v]
+        specs[f"X_{c}"] = P(None, None, None)
+        bufs[f"target_{c}"] = target[c::v]
+        specs[f"target_{c}"] = P(None, None, None)
+        for par in (0, 1):
+            bufs[f"act_{c}_{par}"] = np.zeros((s * b, d), dt)
+            specs[f"act_{c}_{par}"] = P(AXIS, None)
+            bufs[f"act_{c}_{par}g"] = np.zeros((s * b, d), dt)
+            specs[f"act_{c}_{par}g"] = P(AXIS, None)
+        bufs[f"out_{c}"] = np.zeros((s * b, d), dt)
+        specs[f"out_{c}"] = P(AXIS, None)
+        bufs[f"gout_{c}"] = np.zeros((s * b, d), dt)
+        specs[f"gout_{c}"] = P(AXIS, None)
+        bufs[f"stash_a_{c}"] = np.zeros((s * mv, b, d), dt)
+        specs[f"stash_a_{c}"] = P(AXIS, None, None)
+        bufs[f"stash_z_{c}"] = np.zeros((s * mv, b, d), dt)
+        specs[f"stash_z_{c}"] = P(AXIS, None, None)
+        bufs[f"dW_{c}"] = np.zeros((s, d, d), dt)
+        specs[f"dW_{c}"] = P(AXIS, None, None)
+    return bufs, specs, dw.astype(np.float32)
 
 
 def make_pipeline_buffers(

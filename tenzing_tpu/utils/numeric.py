@@ -52,6 +52,18 @@ def gelu_tanh(x):
     return 0.5 * x * (1.0 + np.tanh(np.sqrt(2.0 / np.pi) * (x + 0.044715 * x**3)))
 
 
+def gelu_tanh_grad(x):
+    """Analytic derivative of :func:`gelu_tanh` on a numpy array — the host
+    float64 reference for device-side ``jax.vjp`` of ``jax.nn.gelu`` (used by
+    training-step expected-gradient builders)."""
+    import numpy as np
+
+    c = np.sqrt(2.0 / np.pi)
+    u = c * (x + 0.044715 * x**3)
+    th = np.tanh(u)
+    return 0.5 * (1.0 + th) + 0.5 * x * (1.0 - th**2) * c * (1.0 + 3 * 0.044715 * x**2)
+
+
 def prime_factors(n: int) -> List[int]:
     """Ascending prime factorization (reference numeric.cpp:11-33; used for
     device-grid layout, halo_run_strategy.hpp:80-98)."""

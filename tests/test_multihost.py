@@ -55,7 +55,48 @@ print(f"RANK{pid}_OK {fp}", flush=True)
 """
 
 
-def test_two_process_dfs_explore():
+MCTS_DRIVER = """
+import os, sys
+pid, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+)
+import jax.numpy as jnp
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+from tenzing_tpu.runtime.executor import TraceExecutor
+from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+from tenzing_tpu.solve.mcts import MctsOpts, explore
+from tenzing_tpu.solve.mcts.strategies import FastMin
+from tenzing_tpu.parallel.control_plane import default_control_plane
+
+cp = default_control_plane()
+g = Graph()
+g.start_then(SpMVCompound())
+g.then_finish(SpMVCompound())
+plat = Platform.make_n_lanes(2)
+bufs, _ = make_spmv_buffers(m=128, nnz_per_row=4, seed=0)
+ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+bench = EmpiricalBenchmarker(ex, control_plane=cp)
+res = explore(
+    g, plat, bench,
+    MctsOpts(n_iters=3, bench_opts=BenchOpts(n_iters=2, target_secs=1e-4),
+             seed=0),
+    strategy=FastMin,
+    control_plane=cp,
+)
+assert len(res.sims) == 3  # rank 1 benchmarked every broadcast rollout
+fp = "&".join(s.order.desc() for s in res.sims)
+print(f"RANK{pid}_OK {fp}", flush=True)
+"""
+
+
+def _run_two_ranks(driver: str):
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = str(s.getsockname()[1])
@@ -63,7 +104,7 @@ def test_two_process_dfs_explore():
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", DRIVER, str(pid), port],
+            [sys.executable, "-c", driver, str(pid), port],
             cwd=REPO,
             env=env,
             stdout=subprocess.PIPE,
@@ -88,3 +129,14 @@ def test_two_process_dfs_explore():
     assert fp0 and fp1
     # the broadcast schedules re-materialized identically on both hosts
     assert fp0[0].split(" ", 1)[1] == fp1[0].split(" ", 1)[1]
+
+
+def test_two_process_dfs_explore():
+    _run_two_ranks(DRIVER)
+
+
+def test_two_process_mcts_explore():
+    """The MCTS per-iteration protocol — rank-0 rollout, stop + schedule
+    broadcast, all-rank benchmark, rank-0 backprop (reference
+    mcts.hpp:154-327) — across two real processes."""
+    _run_two_ranks(MCTS_DRIVER)
