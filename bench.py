@@ -259,6 +259,29 @@ def main() -> int:
     # discipline — the one the reference's graph hard-codes via its
     # every-post-before-any-wait edges (ops_halo_exchange.cu:249-256)
     incumbents = []
+    if args.workload == "attn" and not args.smoke:
+        # kernel incumbent: the serialized order with every block choosing the
+        # bf16 Pallas kernel (double MXU throughput) — the likely winner the
+        # directed search should start from, and the final batch must include
+        from tenzing_tpu.core.state import ChooseOp
+        from tenzing_tpu.solve.mcts.mcts import SimResult
+
+        st = State(g)
+        while not st.is_terminal():
+            ds = st.get_decisions(naive_plat)
+            pick = next(
+                (d for d in ds if isinstance(d, ChooseOp)
+                 and d.choice.name().endswith(".pallas_bf16")),
+                ds[0],
+            )
+            st = st.apply(pick)
+        t0 = time.time()
+        bf16 = bench.benchmark(st.sequence, opts)
+        sys.stderr.write(
+            f"bf16-kernel incumbent: pct50={bf16.pct50*1e6:.1f}us "
+            f"(wall {time.time()-t0:.0f}s)\n"
+        )
+        incumbents.append(SimResult(order=st.sequence, result=bf16))
     if args.workload in ("halo", "moe"):
         from tenzing_tpu.solve.mcts.mcts import SimResult
 

@@ -113,8 +113,29 @@ class AttnStepPallas(AttnStep):
         return True
 
 
+class AttnStepPallasBf16(AttnStep):
+    """Pallas kernel with Q/K/V cast to bfloat16 for the MXU matmuls (double
+    the systolic-array throughput; softmax state and accumulation stay
+    float32 via preferred_element_type inside the kernel)."""
+
+    def _update(self, q, k, v, acc, m, l):
+        import jax.numpy as jnp
+
+        from tenzing_tpu.ops.attention_pallas import attn_block_pallas
+
+        bf = jnp.bfloat16
+        return attn_block_pallas(
+            q.astype(bf), k.astype(bf), v.astype(bf), acc, m, l,
+            self._args.scale,
+        )
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
 class AttnStepChoice(ChoiceOp):
-    """Implementation menu for one ring step: XLA einsums vs Pallas kernel."""
+    """Implementation menu for one ring step: XLA einsums vs Pallas kernel
+    (float32 and bfloat16-input variants)."""
 
     def __init__(self, name: str, s: int, args: RingAttnArgs):
         super().__init__(name)
@@ -125,6 +146,7 @@ class AttnStepChoice(ChoiceOp):
         return [
             AttnStep(self.name() + ".xla", self._s, self._args),
             AttnStepPallas(self.name() + ".pallas", self._s, self._args),
+            AttnStepPallasBf16(self.name() + ".pallas_bf16", self._s, self._args),
         ]
 
 
@@ -239,6 +261,15 @@ class BlockAttnStepPallas(BlockAttnStep):
         return True
 
 
+class BlockAttnStepPallasBf16(BlockAttnStep):
+    """Blocked step with the bfloat16-input Pallas kernel update."""
+
+    _update = AttnStepPallasBf16._update
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
 class BlockAttnChoice(ChoiceOp):
     def __init__(self, name: str, s: int, args: RingAttnArgs):
         super().__init__(name)
@@ -249,6 +280,9 @@ class BlockAttnChoice(ChoiceOp):
         return [
             BlockAttnStep(self.name() + ".xla", self._s, self._args),
             BlockAttnStepPallas(self.name() + ".pallas", self._s, self._args),
+            BlockAttnStepPallasBf16(
+                self.name() + ".pallas_bf16", self._s, self._args
+            ),
         ]
 
 

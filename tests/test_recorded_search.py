@@ -169,6 +169,32 @@ def test_moe_recording_replays_with_decisive_margin():
     assert res.pct50 == naive.pct50
 
 
+def test_attn_bf16_recording_replays_with_decisive_margin():
+    """The blocked-attention search recorded on TPU v5e with the 3-way kernel
+    menu (XLA / Pallas f32 / Pallas bf16 — bench.py --workload attn, 8k
+    context): paired speedup 4.329, 95% CI [4.284, 4.347].  Every row —
+    naive, the all-bf16 incumbent, and the MCTS candidates — anchors to the
+    kernel-choice graph."""
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.models.ring_attention import BlockedAttention, RingAttnArgs
+
+    path = os.path.join(REPO, "experiments", "attn_search_tpu_bf16.csv")
+    n_rows = sum(1 for line in open(path) if line.strip())
+    aargs = RingAttnArgs(n_devices=8, batch=4, seq_local=1024, head_dim=128)
+    g = Graph()
+    g.start_then(BlockedAttention(aargs, impl_choice=True))
+    g.then_finish(BlockedAttention(aargs, impl_choice=True))
+    db = CsvBenchmarker.from_file(path, g, strict=True)
+    assert len(db.entries) == n_rows
+    naive, best = db.entries[0][1], min((r for _, r in db.entries),
+                                        key=lambda r: r.pct50)
+    assert best.pct50 < naive.pct01  # decisive under percentile criterion
+    # the winning schedule uses the bf16 kernel on every block
+    best_seq = min(db.entries, key=lambda e: e[1].pct50)[0]
+    n_bf16 = sum(1 for op in best_seq if op.name().endswith(".pallas_bf16"))
+    assert n_bf16 == 8
+
+
 def test_postprocess_on_real_recorded_data():
     """Class-boundary + decision-tree analysis runs on the real CSV and finds
     the searched-fast vs naive-slow structure."""

@@ -31,6 +31,12 @@ def _mesh(nsp):
     return Mesh(devs, ("sp",))
 
 
+def _has_kind(s, suffix):
+    """Whether a schedule contains an op whose name ends with ``suffix``
+    (exact suffix: '.pallas' must not match '.pallas_bf16')."""
+    return any(op.name().endswith(suffix) for op in s.sequence)
+
+
 class TestDagShape:
     def test_rotate_overlaps_compute(self):
         """rotate_s and attn_s must be DAG-independent (the searched overlap)."""
@@ -96,26 +102,34 @@ class TestNumerics:
         g.start_then(BlockedAttention(args, impl_choice=True))
         g.then_finish(BlockedAttention(args, impl_choice=True))
         # fair-share enumeration covers every kernel-menu variant (all-xla,
-        # all-pallas, and mixes)
-        seqs = enumerate_schedules(g, plat, max_seqs=64)
-        names = [";".join(op.name() for op in s.sequence) for s in seqs]
-        pallas = [s for s, n in zip(seqs, names) if ".pallas" in n]
-        xla = [s for s, n in zip(seqs, names) if ".pallas" not in n]
-        assert pallas and xla
+        # all-pallas f32/bf16, and mixes)
+        seqs = enumerate_schedules(g, plat, max_seqs=96)
+        pallas = [s for s in seqs
+                  if _has_kind(s, ".pallas") and not _has_kind(s, ".pallas_bf16")]
+        bf16 = [s for s in seqs if _has_kind(s, ".pallas_bf16")]
+        xla = [s for s in seqs
+               if not _has_kind(s, ".pallas") and not _has_kind(s, ".pallas_bf16")]
+        assert pallas and bf16 and xla
         ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
         for s in (pallas[0], xla[0]):
             out = ex.run(s.sequence)
             np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
+        # bf16 MXU inputs: ~8-bit mantissa, so a looser but still-tight bound
+        out = ex.run(bf16[0].sequence)
+        np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=3e-2, atol=3e-2)
 
     def test_pallas_impl_matches(self):
         """The Pallas kernel choice computes the same O (interpret mode)."""
         args = RingAttnArgs(n_devices=2, batch=1, seq_local=8, head_dim=8)
         bufs, specs, want = make_ring_buffers(args, seed=3)
         plat = Platform.make_n_lanes(1, mesh=_mesh(2), specs=specs)
-        seqs = get_all_sequences(_graph(args, impl_choice=True), plat, max_seqs=60)
-        names = [";".join(op.name() for op in s.sequence) for s in seqs]
-        pallas = [s for s, n in zip(seqs, names) if ".pallas" in n]
-        assert pallas
+        seqs = get_all_sequences(_graph(args, impl_choice=True), plat, max_seqs=90)
+        pallas = [s for s in seqs
+                  if _has_kind(s, ".pallas") and not _has_kind(s, ".pallas_bf16")]
+        bf16 = [s for s in seqs if _has_kind(s, ".pallas_bf16")]
+        assert pallas and bf16
         ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
         out = ex.run(pallas[0].sequence)
         np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
+        out = ex.run(bf16[0].sequence)
+        np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=3e-2, atol=3e-2)
