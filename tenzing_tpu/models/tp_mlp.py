@@ -140,18 +140,26 @@ class TpMlp(CompoundOp):
 
 
 def make_tp_mlp_buffers(
-    args: TpMlpArgs, seed: int = 0
+    args: TpMlpArgs, seed: int = 0, n_dp: int = 1
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
-    """(buffers, partition specs, expected Y) for the TP forward on a 1-D
-    ``("tp",)`` mesh.  W1 is column-sharded, W2 row-sharded (Megatron layout);
-    chunk inputs are replicated; written activations are shard-stacked (see
-    the layout note below)."""
+    """(buffers, partition specs, expected Y) for the TP forward.  W1 is
+    column-sharded, W2 row-sharded (Megatron layout); chunk inputs are
+    replicated across tp; written activations are shard-stacked (see the
+    layout note below).
+
+    With ``n_dp > 1`` the specs target a 2-D ``("dp", "tp")`` mesh — data
+    parallelism composed with tensor parallelism, the standard 2-D training
+    layout: each chunk's batch rows are additionally sharded over ``dp``
+    (``mb_size`` must divide by ``n_dp``), weights are replicated across
+    ``dp``, and the all-reduce still runs over ``tp`` only, so ICI traffic
+    stays within each dp replica's tp group."""
     from jax.sharding import PartitionSpec as P
 
     rng = np.random.default_rng(seed)
     L, v = args.n_layers, args.n_chunks
     b, d, dff = args.mb_size, args.d_model, args.d_ff
     assert dff % args.n_tp == 0, "d_ff must divide across tp shards"
+    assert b % n_dp == 0, "mb_size must divide across dp shards"
     dt = np.dtype(args.dtype)
     x = rng.standard_normal((v * b, d)).astype(dt)
     w1 = rng.standard_normal((L, d, dff)).astype(dt) / np.sqrt(d)
@@ -163,27 +171,39 @@ def make_tp_mlp_buffers(
     for l in range(L):
         y = gelu_tanh(y @ w1[l].astype(np.float64)) @ w2[l].astype(np.float64)
 
-    # written buffers are laid out shard-stacked, P("tp", None), even where
-    # the math makes every shard's block identical (post-psum sums, Y): the
-    # executor's ordering tokens are shard-varying, and a tied value cannot
-    # satisfy a statically-replicated out_spec under shard_map's vma check
+    dp = ("dp",) if n_dp > 1 else ()
+    # written buffers are laid out shard-stacked over tp (and their batch
+    # rows sharded over dp when present), even where the math makes every tp
+    # shard's block identical (post-psum sums, Y): the executor's ordering
+    # tokens are shard-varying, and a tied value cannot satisfy a
+    # statically-replicated out_spec under shard_map's vma check
     bufs: Dict[str, np.ndarray] = {
         "W1": w1,
         "W2": w2,
         "Y": np.zeros((args.n_tp * v * b, d), dt),
     }
     specs: Dict[str, object] = {
-        "W1": P(None, None, AXIS),  # column-sharded
-        "W2": P(None, AXIS, None),  # row-sharded
-        "Y": P(AXIS, None),
+        "W1": P(None, None, AXIS),  # column-sharded, dp-replicated
+        "W2": P(None, AXIS, None),  # row-sharded, dp-replicated
+        "Y": P((AXIS,) + dp, None),
     }
     for c in range(v):
         bufs[f"X_{c}"] = x[c * b : (c + 1) * b]
-        specs[f"X_{c}"] = P(None, None)  # replicated input, never written
+        # batch rows dp-sharded, tp-replicated; never written
+        specs[f"X_{c}"] = P(dp if dp else None, None)
         for l in range(L):
             bufs[f"part_{c}_{l}"] = np.zeros((args.n_tp * b, d), dt)
-            specs[f"part_{c}_{l}"] = P(AXIS, None)
+            specs[f"part_{c}_{l}"] = P((AXIS,) + dp, None)
             bufs[f"sum_{c}_{l}"] = np.zeros((args.n_tp * b, d), dt)
-            specs[f"sum_{c}_{l}"] = P(AXIS, None)
-    want = np.tile(y.astype(np.float32), (args.n_tp, 1))
+            specs[f"sum_{c}_{l}"] = P((AXIS,) + dp, None)
+    # expected Y in the device layout: under P(("tp","dp")) each (tp, dp)
+    # shard holds one contiguous global block containing ITS dp-slice of
+    # every chunk in chunk order — so per tp copy, rows group dp-major
+    bs = b // n_dp
+    per_tp = np.concatenate([
+        np.concatenate([y[c * b + j * bs : c * b + (j + 1) * bs]
+                        for c in range(v)])
+        for j in range(n_dp)
+    ])
+    want = np.tile(per_tp.astype(np.float32), (args.n_tp, 1))
     return bufs, specs, want

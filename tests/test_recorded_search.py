@@ -186,11 +186,10 @@ def test_attn_bf16_recording_replays_with_decisive_margin():
     g.then_finish(BlockedAttention(aargs, impl_choice=True))
     db = CsvBenchmarker.from_file(path, g, strict=True)
     assert len(db.entries) == n_rows
-    naive, best = db.entries[0][1], min((r for _, r in db.entries),
-                                        key=lambda r: r.pct50)
+    naive = db.entries[0][1]
+    best_seq, best = min(db.entries, key=lambda e: e[1].pct50)
     assert best.pct50 < naive.pct01  # decisive under percentile criterion
     # the winning schedule uses the bf16 kernel on every block
-    best_seq = min(db.entries, key=lambda e: e[1].pct50)[0]
     n_bf16 = sum(1 for op in best_seq if op.name().endswith(".pallas_bf16"))
     assert n_bf16 == 8
 

@@ -63,6 +63,21 @@ class TestNumerics:
         np.testing.assert_allclose(np.asarray(out["Y"]), want, rtol=2e-4,
                                    atol=2e-5)
 
+    def test_dp_tp_composed_mesh(self):
+        """The 2-D training layout: batch rows sharded over dp, weights over
+        tp, all-reduce confined to the tp axis."""
+        args = TpMlpArgs(n_tp=2, n_layers=2, n_chunks=2, mb_size=4,
+                         d_model=8, d_ff=16)
+        bufs, specs, want = make_tp_mlp_buffers(args, seed=4, n_dp=2)
+        devs = np.array(jax.devices()[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("dp", "tp"))
+        plat = Platform.make_n_lanes(2, mesh=mesh, specs=specs)
+        ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+        order = get_all_sequences(_graph(args), plat, max_seqs=1)[0].sequence
+        out = ex.run(order)
+        np.testing.assert_allclose(np.asarray(out["Y"]), want, rtol=2e-4,
+                                   atol=2e-5)
+
     def test_every_schedule_is_equivalent(self):
         args = TpMlpArgs(n_tp=2, n_layers=1, n_chunks=2, mb_size=2,
                          d_model=4, d_ff=8)
