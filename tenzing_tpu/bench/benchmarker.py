@@ -35,8 +35,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Protocol, Tuple
 
 from tenzing_tpu.bench.randomness import is_random
-from tenzing_tpu.core.resources import Equivalence
-from tenzing_tpu.core.sequence import Sequence, get_equivalence
+from tenzing_tpu.core.sequence import Sequence, canonical_key
 from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
 from tenzing_tpu.utils.numeric import percentile, stddev
 
@@ -249,30 +248,29 @@ class CachingBenchmarker:
     benchmarker.cpp:169-223, applied online; VERDICT r1 weak #5 — MCTS
     re-benchmarked identical rollouts).
 
-    Entries are bucketed by (opts, sequence length, op eq_keys) — a cheap exact
-    prefilter the bijection check requires anyway — so a lookup scans only the
-    handful of candidates that could match, not every recorded schedule; and a
-    result recorded under one BenchOpts is never returned for another."""
+    Lookup is an O(1) dict hit on (opts, ``canonical_key``) — the canonical
+    form under lane/event renaming is equal exactly when the pairwise
+    bijection check succeeds (core/sequence.py canonical_key) — and a result
+    recorded under one BenchOpts is never returned for another."""
 
     def __init__(self, inner):
         self.inner = inner
-        self._buckets: dict = {}
+        self._cache: dict = {}
         self.hits = 0
         self.misses = 0
 
     @staticmethod
-    def _bucket_key(order: Sequence, opts: Optional[BenchOpts]) -> Tuple:
+    def _key(order: Sequence, opts: Optional[BenchOpts]) -> Tuple:
         ok = (opts.n_iters, opts.max_retries, opts.target_secs) if opts else None
-        return (ok, len(order), tuple(op.eq_key() for op in order))
+        return (ok, canonical_key(order))
 
     def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
-        bucket = self._buckets.setdefault(self._bucket_key(order, opts), [])
-        for stored, res in bucket:
-            if get_equivalence(stored, order):
-                self.hits += 1
-                return res
+        key = self._key(order, opts)
+        if key in self._cache:
+            self.hits += 1
+            return self._cache[key]
         res = self.inner.benchmark(order, opts)
-        bucket.append((order, res))
+        self._cache[key] = res
         self.misses += 1
         return res
 
@@ -315,7 +313,7 @@ class CsvBenchmarker:
     ``self.skipped`` so callers can see what the database did not cover.
 
     ``normalize=True`` matches queries modulo ``remove_redundant_syncs`` (both
-    sides cleaned before the bijection check).  The peephole rules only delete
+    sides cleaned before the canonical-key lookup).  The peephole rules only delete
     sync ops with no execution effect, so normalized-equal schedules are the
     same program — this lets a database recorded by the DFS solver (raw
     terminal sequences) answer queries from the MCTS solver (which cleans
@@ -331,7 +329,7 @@ class CsvBenchmarker:
 
         self._normalize = remove_redundant_syncs if normalize else (lambda s: s)
         self.entries: List[Tuple[Sequence, BenchResult]] = []
-        self._keys: List[Sequence] = []  # normalized match keys, 1:1 with entries
+        self._by_canonical: dict = {}  # canonical(normalized seq) -> result
         self.skipped: List[int] = []
         for i, row in enumerate(rows):
             if not row.strip():
@@ -356,7 +354,9 @@ class CsvBenchmarker:
                 continue
             seq = Sequence(ops)
             self.entries.append((seq, res))
-            self._keys.append(self._normalize(seq))
+            # first row wins for duplicate schedules (e.g. a search-time row
+            # superseded by a final-batch row earlier in the file)
+            self._by_canonical.setdefault(canonical_key(self._normalize(seq)), res)
 
     @classmethod
     def from_file(cls, path: str, graph, strict: bool = True,
@@ -366,10 +366,9 @@ class CsvBenchmarker:
                        normalize=normalize)
 
     def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
-        query = self._normalize(order)
-        for key, (_, res) in zip(self._keys, self.entries):
-            if get_equivalence(key, query):
-                return res
-        raise KeyError(
-            f"no recorded schedule equivalent to: {order.desc()}"
-        )
+        res = self._by_canonical.get(canonical_key(self._normalize(order)))
+        if res is None:
+            raise KeyError(
+                f"no recorded schedule equivalent to: {order.desc()}"
+            )
+        return res

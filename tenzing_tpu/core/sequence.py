@@ -118,3 +118,33 @@ def get_equivalence(a: Sequence, b: Sequence, base: Optional[Equivalence] = None
 
 def is_equivalent(a: Sequence, b: Sequence) -> bool:
     return bool(get_equivalence(a, b))
+
+
+def canonical_key(seq: Sequence) -> tuple:
+    """A hashable canonical form of ``seq`` under lane/event renaming:
+    per op, (eq_key, lanes relabeled in first-use order, events likewise).
+
+    Two sequences are bijection-equivalent (``get_equivalence`` with no base)
+    iff their canonical keys are equal: a consistent bijection must map the
+    i-th distinct lane of one to the i-th distinct lane of the other (at each
+    first use, injectivity in both directions forces fresh->fresh), so a
+    bijection exists exactly when the first-use-relabeled streams coincide.
+    This is the O(1)-lookup replacement for pairwise bijection scans (the
+    same canonicalization the native core's canonical_key uses,
+    native/src/core.cpp) — ``get_equivalence`` remains the semantic ground
+    truth and the cross-check test asserts agreement.
+    """
+    lanes: dict = {}
+    events: dict = {}
+    items = []
+    for op in seq:
+        ls = tuple(
+            lanes.setdefault(l.id, len(lanes))
+            for l in (op.lanes() if hasattr(op, "lanes") else [])
+        )
+        es = tuple(
+            events.setdefault(e.id, len(events))
+            for e in (op.events() if hasattr(op, "events") else [])
+        )
+        items.append((op.eq_key(), ls, es))
+    return tuple(items)

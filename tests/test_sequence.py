@@ -70,3 +70,48 @@ def test_equivalence_returns_bijection():
     s2 = Sequence([a.bind(Lane(3))])
     e = get_equivalence(s1, s2)
     assert e and e.lanes[Lane(0)] == Lane(3)
+
+
+def test_canonical_key_agrees_with_pairwise_bijection():
+    """canonical_key equality must coincide with get_equivalence on every pair
+    from a real enumerated schedule space (it is the O(1) lookup the
+    benchmarker caches use; get_equivalence is the semantic ground truth)."""
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.sequence import canonical_key
+    from tenzing_tpu.models.spmv import SpMVCompound
+    from tenzing_tpu.solve.dfs import get_all_sequences
+
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    plat = Platform.make_n_lanes(2)
+    seqs = [s.sequence for s in get_all_sequences(g, plat, max_seqs=12)]
+    assert len(seqs) >= 6
+    for i, a in enumerate(seqs):
+        for b in seqs[i:]:
+            assert bool(get_equivalence(a, b)) == (
+                canonical_key(a) == canonical_key(b)
+            ), (a.desc(), b.desc())
+
+
+def test_canonical_key_relabels_resources():
+    from tenzing_tpu.core.sequence import canonical_key
+
+    a = KOp("a")
+    b = KOp("b")
+
+    def seq(l0, l1, e):
+        return Sequence(
+            [Start(), a.bind(l0), EventRecord(l0, e), WaitEvent(l1, e),
+             b.bind(l1)]
+        )
+
+    # same schedule under renamed lanes/events: identical canonical keys
+    assert canonical_key(seq(Lane(0), Lane(1), Event(0))) == canonical_key(
+        seq(Lane(1), Lane(0), Event(4))
+    )
+    # collapsing the two lanes into one changes the key
+    assert canonical_key(seq(Lane(0), Lane(1), Event(0))) != canonical_key(
+        seq(Lane(0), Lane(0), Event(0))
+    )

@@ -83,3 +83,49 @@ def test_unknown_op_raises():
     g = Graph()
     with pytest.raises(KeyError):
         sequence_from_json_str('[{"kind": "device", "name": "ghost"}]', g)
+
+
+def test_comm_ops_round_trip_through_graph_anchoring():
+    """Every comm-op kind (post/wait vocabulary, ops/comm_ops.py) serializes
+    to JSON and re-anchors against the graph by name — the path recorded
+    search databases and the schedule broadcast depend on."""
+    import json
+
+    from tenzing_tpu.core.sequence import Sequence
+    from tenzing_tpu.core.serdes import sequence_from_json_str, sequence_to_json
+    from tenzing_tpu.ops.comm_ops import (
+        AllToAllStart,
+        AwaitTransfer,
+        HostFetchStart,
+        HostSpillStart,
+        MultiAwait,
+        PermuteStart,
+        PsumStart,
+    )
+
+    ops = [
+        HostSpillStart("spill_x", "x", "hx"),
+        HostFetchStart("fetch_x", "hx", "rx"),
+        PermuteStart("perm_x", "rx", "px", axis="sp", shift=2),
+        AllToAllStart("a2a_x", "px", "ax", axis="ep", split_axis=0),
+        PsumStart("psum_x", "ax", "sx", axis="tp"),
+        AwaitTransfer("await_x", "sx"),
+        MultiAwait("mwait", ["rx", "sx"]),
+    ]
+    g = Graph()
+    prev = None
+    for op in ops:
+        if prev is None:
+            g.start_then(op)
+        else:
+            g.then(prev, op)
+        prev = op
+    g.then_finish(prev)
+    payload = json.dumps(sequence_to_json(Sequence(ops)))
+    out = sequence_from_json_str(payload, g)
+    assert [o.name() for o in out] == [o.name() for o in ops]
+    assert [type(o) for o in out] == [type(o) for o in ops]
+    # parameters survive (the rebuilt ops are the graph's own instances)
+    assert out[2].to_json()["shift"] == 2
+    assert out[3].to_json()["split_axis"] == 0
+    assert out[4].to_json()["axis"] == "tp"
