@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""On-device numerics validation: real TPU, real (non-interpret) Pallas.
+
+The CPU test suite runs every kernel in Pallas interpret mode (SURVEY.md §4:
+the reference's CI is likewise a CPU subset); this script is the device tier —
+it executes each single-chip workload's searched program on the actual chip,
+with the Pallas kernels compiled by Mosaic, and checks the outputs against the
+host float64 references.  Writes ``experiments/TPU_NUMERICS.json`` so the
+validation is a recorded artifact, and is importable by the opt-in pytest
+wrapper (tests/test_device_numerics.py, gated on TENZING_TPU_DEVICE_TESTS=1).
+
+Run: ``python experiments/device_numerics.py`` (needs a TPU backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def check_spmv(results):
+    """SpMV compound with the Pallas kernel choice forced (device Mosaic)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import ChooseOp, State
+    from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    bufs, want = make_spmv_buffers(m=2048, nnz_per_row=8, seed=3)
+    x_sizes = {"x_local": int(bufs["x_local"].shape[0]),
+               "x_remote": int(bufs["x_remote"].shape[0])}
+    g = Graph()
+    g.start_then(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
+    g.then_finish(SpMVCompound(impl_choice=True, x_sizes=x_sizes))
+    plat = Platform.make_n_lanes(1)
+    st = State(g)
+    picked_pallas = 0
+    while not st.is_terminal():
+        ds = st.get_decisions(plat)
+        pick = next((d for d in ds if isinstance(d, ChooseOp)
+                     and ".pallas" in d.choice.name()), ds[0])
+        if isinstance(pick, ChooseOp) and ".pallas" in pick.choice.name():
+            picked_pallas += 1
+        st = st.apply(pick)
+    ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+    out = ex.run(st.sequence)
+    err = float(np.max(np.abs(np.asarray(out["y"]) - want)
+                       / (np.abs(want) + 1e-6)))
+    results["spmv_pallas"] = {"pallas_choices": picked_pallas,
+                              "max_rel_err": err, "ok": err < 2e-3}
+
+
+def check_attention(results):
+    """Blocked attention, f32 and bf16 Pallas kernels on the MXU."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import ChooseOp, State
+    from tenzing_tpu.models.ring_attention import (
+        BlockedAttention,
+        RingAttnArgs,
+        make_blocked_buffers,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    args = RingAttnArgs(n_devices=4, batch=2, seq_local=256, head_dim=128)
+    bufs, want = make_blocked_buffers(args, seed=4)
+    # note: on this backend f32 and bf16 kernels produce identical outputs —
+    # xla_allow_excess_precision truncates f32 matmul operands to bf16 on the
+    # MXU anyway, so the bf16 menu entry costs no additional precision here
+    # and its speedup is HBM bandwidth (half-width K/V block loads)
+    for suffix, tol, key in ((".pallas", 2e-3, "attn_pallas_f32"),
+                             (".pallas_bf16", 4e-2, "attn_pallas_bf16")):
+        g = Graph()
+        g.start_then(BlockedAttention(args, impl_choice=True))
+        g.then_finish(BlockedAttention(args, impl_choice=True))
+        plat = Platform.make_n_lanes(1)
+        st = State(g)
+        while not st.is_terminal():
+            ds = st.get_decisions(plat)
+            pick = next((d for d in ds if isinstance(d, ChooseOp)
+                         and d.choice.name().endswith(suffix)), ds[0])
+            st = st.apply(pick)
+        ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+        out = ex.run(st.sequence)
+        err = float(np.max(np.abs(np.asarray(out["O"]) - want)))
+        results[key] = {"max_abs_err": err, "ok": err < tol}
+
+
+def check_moe_pipeline(results):
+    """MoE dispatch/combine through real host-staged DMAs + the Pallas
+    hidden-tiled expert kernel.
+
+    Two-tier check: the Pallas schedule must match the XLA schedule *on the
+    device* tightly (kernel equivalence), and both match the float64 host
+    reference at the platform's matmul precision — this backend runs with
+    ``xla_allow_excess_precision``, under which f32 matmuls truncate their
+    operands to bf16 on the MXU (measured: an f32 dot of bf16-rounded inputs
+    is bit-identical to the f32 dot), so device-vs-host carries an inherent
+    ~1e-2 deviation that is a platform property, not a kernel defect."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import ChooseOp, State
+    from tenzing_tpu.models.moe_pipeline import (
+        MoEPipeArgs,
+        build_graph,
+        host_buffer_names,
+        make_pipe_buffers,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    args = MoEPipeArgs(n_experts=4, tokens=1024, d_model=256, d_ff=1024,
+                       n_chunks=2)
+    bufs, want, cap = make_pipe_buffers(args, seed=5)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names(args))
+    g = build_graph(args, cap, impl_choice=True)
+    plat = Platform.make_n_lanes(2)
+    outs = {}
+    for suffix in (".pallas", ".xla"):
+        st = State(g)
+        while not st.is_terminal():
+            ds = st.get_decisions(plat)
+            pick = next((d for d in ds if isinstance(d, ChooseOp)
+                         and d.choice.name().endswith(suffix)), ds[0])
+            st = st.apply(pick)
+        ex = TraceExecutor(plat, jbufs)
+        outs[suffix] = np.asarray(ex.run(st.sequence)["Y"])
+    kernel_err = float(np.max(np.abs(outs[".pallas"] - outs[".xla"])))
+    host_err = float(np.max(np.abs(outs[".pallas"] - want)))
+    results["moe_pipeline_pallas"] = {
+        "pallas_vs_xla_max_abs": kernel_err,
+        "vs_host_f64_max_abs": host_err,
+        "ok": kernel_err < 1e-5 and host_err < 5e-2,
+    }
+
+
+def check_halo_pipeline(results):
+    """Halo pipeline: pack -> host round trip -> unpack with the Pallas
+    pack/unpack kernels (small grid; the bench covers the 512^3 scale)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import ChooseOp, State
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.models.halo_pipeline import (
+        build_graph,
+        host_buffer_names,
+        make_pipeline_buffers,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    args = HaloArgs(nq=2, lx=16, ly=16, lz=128, radius=2)
+    bufs, want = make_pipeline_buffers(args, seed=6)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    g = build_graph(args, impl_choice=True)
+    plat = Platform.make_n_lanes(2)
+    st = State(g)
+    while not st.is_terminal():
+        ds = st.get_decisions(plat)
+        pick = next((d for d in ds if isinstance(d, ChooseOp)
+                     and ".pallas" in d.choice.name()), ds[0])
+        st = st.apply(pick)
+    ex = TraceExecutor(plat, jbufs)
+    out = ex.run(st.sequence)
+    err = float(np.max(np.abs(np.asarray(out["U"]) - want)))
+    results["halo_pipeline_pallas"] = {"max_abs_err": err, "ok": err == 0.0}
+
+
+CHECKS = (check_spmv, check_attention, check_moe_pipeline, check_halo_pipeline)
+
+
+def run_all() -> dict:
+    import jax
+
+    devs = jax.devices()
+    results: dict = {
+        "backend": str(devs),
+        "is_tpu": jax.default_backend() == "tpu",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    for check in CHECKS:
+        t0 = time.time()
+        check(results)
+        sys.stderr.write(f"{check.__name__} done ({time.time()-t0:.0f}s)\n")
+    results["all_ok"] = all(
+        v.get("ok") for v in results.values() if isinstance(v, dict)
+    )
+    return results
+
+
+def main() -> int:
+    results = run_all()
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "TPU_NUMERICS.json")
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+    return 0 if results["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

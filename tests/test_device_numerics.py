@@ -1,0 +1,43 @@
+"""The recorded on-device numerics artifact (experiments/TPU_NUMERICS.json,
+written by experiments/device_numerics.py on a real TPU) must exist and be
+healthy — the device tier of the test strategy (SURVEY.md §4: CPU subset in
+CI, device execution recorded as an artifact).  Re-run the script on a chip
+to refresh it; set TENZING_TPU_DEVICE_TESTS=1 to run the checks live from
+pytest (requires a TPU backend — the default conftest forces CPU, where the
+live run exercises the interpret path only)."""
+
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "experiments", "TPU_NUMERICS.json")
+
+
+def test_recorded_device_numerics_artifact_is_healthy():
+    with open(ARTIFACT) as f:
+        rec = json.load(f)
+    assert rec["is_tpu"], "artifact must be recorded on a real TPU backend"
+    assert rec["all_ok"], rec
+    checks = {k: v for k, v in rec.items() if isinstance(v, dict)}
+    assert set(checks) == {
+        "spmv_pallas",
+        "attn_pallas_f32",
+        "attn_pallas_bf16",
+        "moe_pipeline_pallas",
+        "halo_pipeline_pallas",
+    }
+    # the kernel-equivalence tier is tight regardless of platform precision
+    assert checks["moe_pipeline_pallas"]["pallas_vs_xla_max_abs"] < 1e-5
+
+
+@pytest.mark.skipif(
+    os.environ.get("TENZING_TPU_DEVICE_TESTS") != "1",
+    reason="live device numerics are opt-in (TENZING_TPU_DEVICE_TESTS=1)",
+)
+def test_live_device_numerics():
+    from experiments.device_numerics import run_all
+
+    results = run_all()
+    assert results["all_ok"], results
