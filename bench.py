@@ -145,10 +145,15 @@ def build_moe(args):
                             n_chunks=2)
     else:
         margs = MoEPipeArgs(tokens=args.moe_tokens)
-    bufs, _, cap = make_pipe_buffers(margs, seed=0, with_expected=False)
-    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names(margs))
+    # the searched space includes the staging-precision menu (f32 vs
+    # half-width bf16 transfers) on the real chip
+    staging = "f32" if args.smoke else "choice"
+    bufs, _, cap = make_pipe_buffers(margs, seed=0, with_expected=False,
+                                     staging=staging)
+    jbufs = TraceExecutor.place_host_buffers(
+        bufs, host_buffer_names(margs, staging=staging))
     impl_choice = not args.smoke  # same rationale as build_halo
-    g = build_graph(margs, cap, impl_choice=impl_choice)
+    g = build_graph(margs, cap, impl_choice=impl_choice, staging=staging)
     return g, jbufs, metric_for("moe", args), (margs, cap)
 
 
@@ -288,18 +293,29 @@ def main() -> int:
         if args.workload == "halo":
             from tenzing_tpu.models.halo_pipeline import greedy_overlap_order
 
-            greedy_seq = greedy_overlap_order(built[3], plat)
+            greedy_seqs = [("greedy-overlap", greedy_overlap_order(built[3], plat))]
         else:
             from tenzing_tpu.models.moe_pipeline import greedy_overlap_order
 
-            greedy_seq = greedy_overlap_order(built[3][0], built[3][1], plat)
-        t0 = time.time()
-        greedy = bench.benchmark(greedy_seq, opts)
-        sys.stderr.write(
-            f"greedy-overlap incumbent: pct50={greedy.pct50*1e6:.1f}us "
-            f"(wall {time.time()-t0:.0f}s)\n"
-        )
-        incumbents.append(SimResult(order=greedy_seq, result=greedy))
+            margs_, cap_ = built[3]
+            greedy_seqs = [
+                ("greedy-overlap", greedy_overlap_order(margs_, cap_, plat))
+            ]
+            if not args.smoke:
+                # the half-width-transfer incumbent (bf16 staging): the
+                # likely winner the search should start from
+                greedy_seqs.append((
+                    "greedy-overlap-bf16",
+                    greedy_overlap_order(margs_, cap_, plat, staging="bf16"),
+                ))
+        for label, greedy_seq in greedy_seqs:
+            t0 = time.time()
+            greedy = bench.benchmark(greedy_seq, opts)
+            sys.stderr.write(
+                f"{label} incumbent: pct50={greedy.pct50*1e6:.1f}us "
+                f"(wall {time.time()-t0:.0f}s)\n"
+            )
+            incumbents.append(SimResult(order=greedy_seq, result=greedy))
 
     # directed search over the 2-lane order x lane x kernel space
     t0 = time.time()

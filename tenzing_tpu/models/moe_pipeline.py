@@ -73,38 +73,50 @@ def _slot_shape(args: MoEPipeArgs, cap: int) -> Tuple[int, int, int]:
 
 class DispatchPackPipe(DeviceOp):
     """Gather chunk ``c``'s routed tokens into the capacity-padded slot table
-    and emit it in the (rows, 128) staging layout the host round trip needs."""
+    and emit it in the (rows, 128) staging layout the host round trip needs.
+    With ``prec="bf16"`` the staging buffer is bfloat16 — half the DMA bytes,
+    and numerically free on this platform: the expert matmuls truncate their
+    operands to bf16 on the MXU regardless (xla_allow_excess_precision,
+    experiments/device_numerics.py)."""
 
-    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int,
+                 prec: str = "f32"):
         super().__init__(name)
         self._c, self._args, self._cap = c, args, cap
+        self._sfx = "16" if prec == "bf16" else ""
 
     def reads(self):
         return ["X", f"idx_{self._c}"]
 
     def writes(self):
-        return [f"send_{self._c}"]
+        return [f"send{self._sfx}_{self._c}"]
 
     def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
         a, tc_ = self._args, self._args.chunk_tokens
         xc = bufs["X"][self._c * tc_ : (self._c + 1) * tc_]  # (Tc, d)
         slots = xc[bufs[f"idx_{self._c}"]]  # (E, C, d)
-        return {f"send_{self._c}": flatten_face(slots, _slot_shape(a, self._cap))}
+        if self._sfx:
+            slots = slots.astype(jnp.bfloat16)
+        return {f"send{self._sfx}_{self._c}": flatten_face(slots, _slot_shape(a, self._cap))}
 
 
 class ExpertFFNPipe(DeviceOp):
     """Run every resident expert's gelu MLP over its received slots (the MXU
     compute the DMAs hide behind)."""
 
-    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int,
+                 prec: str = "f32"):
         super().__init__(name)
         self._c, self._args, self._cap = c, args, cap
+        self._sfx = "16" if prec == "bf16" else ""
 
     def reads(self):
-        return [f"recv_{self._c}", "W1", "W2"]
+        return [f"recv{self._sfx}_{self._c}", "W1", "W2"]
 
     def writes(self):
-        return [f"out_{self._c}"]
+        return [f"out{self._sfx}_{self._c}"]
 
     def _mlp(self, x3, w1, w2):
         import jax
@@ -119,10 +131,14 @@ class ExpertFFNPipe(DeviceOp):
         )
 
     def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
         shape = _slot_shape(self._args, self._cap)
-        x3 = unflatten_face(bufs[f"recv_{self._c}"], shape)
-        y = self._mlp(x3, bufs["W1"], bufs["W2"]).astype(x3.dtype)
-        return {f"out_{self._c}": flatten_face(y, shape)}
+        raw = unflatten_face(bufs[f"recv{self._sfx}_{self._c}"], shape)
+        x3 = raw.astype(jnp.float32) if self._sfx else raw
+        y = self._mlp(x3, bufs["W1"], bufs["W2"])
+        y = y.astype(jnp.bfloat16 if self._sfx else x3.dtype)
+        return {f"out{self._sfx}_{self._c}": flatten_face(y, shape)}
 
 
 class ExpertFFNPipePallas(ExpertFFNPipe):
@@ -139,15 +155,18 @@ class ExpertFFNPipePallas(ExpertFFNPipe):
 
 
 class ExpertFFNPipeChoice(ChoiceOp):
-    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int,
+                 prec: str = "f32"):
         super().__init__(name)
-        self._c, self._args, self._cap = c, args, cap
+        self._c, self._args, self._cap, self._prec = c, args, cap, prec
 
     def choices(self) -> List[OpBase]:
         return [
-            ExpertFFNPipe(self.name() + ".xla", self._c, self._args, self._cap),
+            ExpertFFNPipe(self.name() + ".xla", self._c, self._args, self._cap,
+                          self._prec),
             ExpertFFNPipePallas(
-                self.name() + ".pallas", self._c, self._args, self._cap
+                self.name() + ".pallas", self._c, self._args, self._cap,
+                self._prec
             ),
         ]
 
@@ -156,12 +175,14 @@ class CombinePipe(DeviceOp):
     """Scatter-add the returned expert outputs into token order scaled by the
     gate weights (padding slots carry weight 0)."""
 
-    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int):
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int,
+                 prec: str = "f32"):
         super().__init__(name)
         self._c, self._args, self._cap = c, args, cap
+        self._sfx = "16" if prec == "bf16" else ""
 
     def reads(self):
-        return [f"ret_{self._c}", f"idx_{self._c}", f"w_{self._c}"]
+        return [f"ret{self._sfx}_{self._c}", f"idx_{self._c}", f"w_{self._c}"]
 
     def writes(self):
         return [f"Y_{self._c}"]
@@ -170,7 +191,9 @@ class CombinePipe(DeviceOp):
         import jax.numpy as jnp
 
         a = self._args
-        vals = unflatten_face(bufs[f"ret_{self._c}"], _slot_shape(a, self._cap))
+        vals = unflatten_face(bufs[f"ret{self._sfx}_{self._c}"],
+                              _slot_shape(a, self._cap))
+        vals = vals.astype(jnp.float32)
         idx = bufs[f"idx_{self._c}"].reshape(-1)
         w = bufs[f"w_{self._c}"].reshape(-1, 1)
         y = jnp.zeros((a.chunk_tokens, a.d_model), vals.dtype)
@@ -198,33 +221,88 @@ class ConcatPipe(DeviceOp):
         }
 
 
-def chunk_ops(args: MoEPipeArgs, c: int, cap: int, impl_choice: bool = False):
-    """The 9-op chain for one microbatch chunk."""
+def chunk_ops(args: MoEPipeArgs, c: int, cap: int, impl_choice: bool = False,
+              prec: str = "f32"):
+    """The 9-op chain for one microbatch chunk.  ``prec="bf16"`` routes the
+    staged transfers through the half-width bfloat16 buffer set (op and
+    buffer names carry a ``16`` suffix so both variants can coexist in one
+    choice graph)."""
+    s = "16" if prec == "bf16" else ""
     mk = ExpertFFNPipeChoice if impl_choice else ExpertFFNPipe
-    pack = DispatchPackPipe(f"pack_{c}", c, args, cap)
-    spilld = HostSpillStart(f"spilld_{c}", f"send_{c}", f"hdisp_{c}")
-    fetchd = HostFetchStart(f"fetchd_{c}", f"hdisp_{c}", f"recv_{c}")
-    awaitd = AwaitTransfer(f"awaitd_{c}", f"recv_{c}")
-    ffn = mk(f"ffn_{c}", c, args, cap)
-    spillc = HostSpillStart(f"spillc_{c}", f"out_{c}", f"hcomb_{c}")
-    fetchc = HostFetchStart(f"fetchc_{c}", f"hcomb_{c}", f"ret_{c}")
-    awaitc = AwaitTransfer(f"awaitc_{c}", f"ret_{c}")
-    comb = CombinePipe(f"combine_{c}", c, args, cap)
+    pack = DispatchPackPipe(f"pack{s}_{c}", c, args, cap, prec)
+    spilld = HostSpillStart(f"spilld{s}_{c}", f"send{s}_{c}", f"hdisp{s}_{c}")
+    fetchd = HostFetchStart(f"fetchd{s}_{c}", f"hdisp{s}_{c}", f"recv{s}_{c}")
+    awaitd = AwaitTransfer(f"awaitd{s}_{c}", f"recv{s}_{c}")
+    ffn = mk(f"ffn{s}_{c}", c, args, cap, prec)
+    spillc = HostSpillStart(f"spillc{s}_{c}", f"out{s}_{c}", f"hcomb{s}_{c}")
+    fetchc = HostFetchStart(f"fetchc{s}_{c}", f"hcomb{s}_{c}", f"ret{s}_{c}")
+    awaitc = AwaitTransfer(f"awaitc{s}_{c}", f"ret{s}_{c}")
+    comb = CombinePipe(f"combine{s}_{c}", c, args, cap, prec)
     return pack, spilld, fetchd, awaitd, ffn, spillc, fetchc, awaitc, comb
+
+
+class ChunkChain(CompoundOp):
+    """One chunk's whole dispatch->expert->combine chain as a compound, at a
+    fixed staging precision — the unit the staging ChoiceOp selects."""
+
+    def __init__(self, c: int, args: MoEPipeArgs, cap: int,
+                 impl_choice: bool, prec: str):
+        super().__init__(f"chain_{c}.{prec}")
+        self._c, self._args, self._cap = c, args, cap
+        self._impl_choice, self._prec = impl_choice, prec
+
+    def graph(self) -> Graph:
+        g = Graph()
+        ops = chunk_ops(self._args, self._c, self._cap, self._impl_choice,
+                        self._prec)
+        g.start_then(ops[0])
+        for a, b in zip(ops, ops[1:]):
+            g.then(a, b)
+        g.then_finish(ops[-1])
+        return g
+
+
+class StagingChoice(ChoiceOp):
+    """The staging-precision menu for one chunk: f32 transfers vs half-width
+    bf16 transfers.  On this platform bf16 staging is numerically free on the
+    dispatch side (the expert matmuls truncate operands to bf16 regardless —
+    xla_allow_excess_precision, experiments/device_numerics.py) and rounds
+    the combine-side outputs to bf16; whether the halved DMA bytes win is the
+    solver's question."""
+
+    def __init__(self, c: int, args: MoEPipeArgs, cap: int, impl_choice: bool):
+        super().__init__(f"chain_{c}")
+        self._c, self._args, self._cap = c, args, cap
+        self._impl_choice = impl_choice
+
+    def choices(self) -> List[OpBase]:
+        return [
+            ChunkChain(self._c, self._args, self._cap, self._impl_choice, "f32"),
+            ChunkChain(self._c, self._args, self._cap, self._impl_choice, "bf16"),
+        ]
 
 
 PHASES = ("start", "pack", "spilld", "fetchd", "awaitd", "ffn", "spillc",
           "fetchc", "awaitc", "combine", "concat", "finish")
 
 
-def build_graph(args: MoEPipeArgs, cap: int, impl_choice: bool = False) -> Graph:
+def build_graph(args: MoEPipeArgs, cap: int, impl_choice: bool = False,
+                staging: str = "f32") -> Graph:
     """``n_chunks`` independent chains joined by the final concat (the
     multi-chip MoELayer's shape with the all-to-alls replaced by host round
-    trips)."""
+    trips).  ``staging``: "f32" or "bf16" wires that variant directly;
+    "choice" wraps each chunk's chain in a :class:`StagingChoice` so the
+    solver also searches the transfer precision (buffers must come from
+    ``make_pipe_buffers(..., staging="choice")``)."""
     g = Graph()
     cat = ConcatPipe("concat", args)
     for c in range(args.n_chunks):
-        ops = chunk_ops(args, c, cap, impl_choice)
+        if staging == "choice":
+            chain = StagingChoice(c, args, cap, impl_choice)
+            g.start_then(chain)
+            g.then(chain, cat)
+            continue
+        ops = chunk_ops(args, c, cap, impl_choice, prec=staging)
         g.start_then(ops[0])
         for a, b in zip(ops, ops[1:]):
             g.then(a, b)
@@ -246,12 +324,15 @@ def naive_order(args: MoEPipeArgs, cap: int, platform) -> Sequence:
     return Sequence(ops)
 
 
-def greedy_overlap_order(args: MoEPipeArgs, cap: int, platform) -> Sequence:
+def greedy_overlap_order(args: MoEPipeArgs, cap: int, platform,
+                         staging: str = "f32") -> Sequence:
     """Phase-ordered incumbent: all packs, all dispatch posts, ... — the
-    software-pipelined discipline, via the shared greedy (solve/greedy.py)."""
+    software-pipelined discipline, via the shared greedy (solve/greedy.py).
+    ``staging="bf16"`` yields the half-width-transfer incumbent."""
     from tenzing_tpu.solve.greedy import greedy_phase_order
 
-    return greedy_phase_order(build_graph(args, cap), platform, PHASES)
+    return greedy_phase_order(build_graph(args, cap, staging=staging),
+                              platform, PHASES)
 
 
 def route_tokens(
@@ -287,10 +368,14 @@ def route_tokens(
 
 
 def make_pipe_buffers(
-    args: MoEPipeArgs, seed: int = 0, with_expected: bool = True
+    args: MoEPipeArgs, seed: int = 0, with_expected: bool = True,
+    staging: str = "f32"
 ) -> Tuple[Dict[str, np.ndarray], Optional[np.ndarray], int]:
     """(buffers, expected Y or None, capacity).  Routing runs here on the
-    host; the expected Y is the dense routed evaluation in float64."""
+    host; the expected Y is the dense routed evaluation in float64.
+    ``staging`` declares the transfer buffer set(s) to match ``build_graph``:
+    "f32", "bf16", or "choice" (both sets — either chain variant may
+    execute)."""
     rng = np.random.default_rng(seed)
     e_, t, d, dff = args.n_experts, args.tokens, args.d_model, args.d_ff
     dt = np.dtype(args.dtype)
@@ -305,10 +390,16 @@ def make_pipe_buffers(
     bufs.update(tables)
     rows = -(-int(np.prod(_slot_shape(args, cap))) // 128)
     flat = np.zeros((rows, 128), dt)
+    import ml_dtypes  # ships with jax
+
+    flat16 = np.zeros((rows, 128), ml_dtypes.bfloat16)
+    suffixes = {"f32": ("",), "bf16": ("16",), "choice": ("", "16")}[staging]
     for c in range(args.n_chunks):
-        for nm in (f"send_{c}", f"hdisp_{c}", f"recv_{c}", f"out_{c}",
-                   f"hcomb_{c}", f"ret_{c}"):
-            bufs[nm] = flat.copy()
+        for s in suffixes:
+            proto = flat16 if s else flat
+            for nm in (f"send{s}_{c}", f"hdisp{s}_{c}", f"recv{s}_{c}",
+                       f"out{s}_{c}", f"hcomb{s}_{c}", f"ret{s}_{c}"):
+                bufs[nm] = proto.copy()
         bufs[f"Y_{c}"] = np.zeros((args.chunk_tokens, d), dt)
 
     want = None
@@ -325,8 +416,9 @@ def make_pipe_buffers(
     return bufs, want, cap
 
 
-def host_buffer_names(args: MoEPipeArgs) -> List[str]:
+def host_buffer_names(args: MoEPipeArgs, staging: str = "f32") -> List[str]:
     """Buffers the caller must device_put into pinned_host."""
-    return [f"hdisp_{c}" for c in range(args.n_chunks)] + [
-        f"hcomb_{c}" for c in range(args.n_chunks)
+    suffixes = {"f32": ("",), "bf16": ("16",), "choice": ("", "16")}[staging]
+    return [f"hdisp{s}_{c}" for c in range(args.n_chunks) for s in suffixes] + [
+        f"hcomb{s}_{c}" for c in range(args.n_chunks) for s in suffixes
     ]

@@ -89,6 +89,42 @@ class TestNumerics:
                                    atol=2e-5)
 
 
+class TestStagingPrecision:
+    def test_bf16_chain_matches_within_bf16_tolerance(self):
+        bufs, want, cap = make_pipe_buffers(SMALL, seed=6, staging="bf16")
+        plat = Platform.make_n_lanes(2)
+        jbufs = TraceExecutor.place_host_buffers(
+            bufs, host_buffer_names(SMALL, staging="bf16"))
+        ex = TraceExecutor(plat, jbufs)
+        out = ex.run(greedy_overlap_order(SMALL, cap, plat, staging="bf16"))
+        np.testing.assert_allclose(np.asarray(out["Y"]), want, rtol=4e-2,
+                                   atol=4e-2)
+
+    def test_choice_graph_offers_both_stagings(self):
+        from tenzing_tpu.solve.dfs import enumerate_schedules
+
+        args = MoEPipeArgs(n_experts=2, tokens=8, d_model=8, d_ff=16,
+                           n_chunks=1)
+        bufs, want, cap = make_pipe_buffers(args, seed=7, staging="choice")
+        plat = Platform.make_n_lanes(1)
+        seqs = enumerate_schedules(build_graph(args, cap, staging="choice"),
+                                   plat, max_seqs=16)
+        f32 = [s for s in seqs
+               if any(op.name().startswith("pack_") for op in s.sequence)]
+        bf16 = [s for s in seqs
+                if any(op.name().startswith("pack16_") for op in s.sequence)]
+        assert f32 and bf16
+        jbufs = TraceExecutor.place_host_buffers(
+            bufs, host_buffer_names(args, staging="choice"))
+        ex = TraceExecutor(plat, jbufs)
+        out32 = ex.run(f32[0].sequence)
+        np.testing.assert_allclose(np.asarray(out32["Y"]), want, rtol=2e-3,
+                                   atol=2e-5)
+        out16 = ex.run(bf16[0].sequence)
+        np.testing.assert_allclose(np.asarray(out16["Y"]), want, rtol=4e-2,
+                                   atol=4e-2)
+
+
 class TestRouting:
     def test_every_token_lands_in_one_slot(self):
         bufs, _want, cap = make_pipe_buffers(SMALL, seed=5)
