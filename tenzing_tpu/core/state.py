@@ -188,13 +188,23 @@ class State:
 
     def frontier(self, platform, dedup: bool = True) -> List["State"]:
         """All successor states, deduplicated under resource-renaming equivalence
-        (implements the dedup the reference left unimplemented, state.cpp:121)."""
+        (implements the dedup the reference left unimplemented, state.cpp:121).
+
+        Candidates are bucketed by the sequence's O(1) ``canonical_key`` —
+        states in different buckets cannot be equivalent (state equivalence
+        requires sequence equivalence, which canonical keys decide exactly) —
+        and only within a bucket does the full pairwise state check (sequence
+        AND graph under one consistent bijection) run."""
         succs = [self.apply(d) for d in self.get_decisions(platform)]
         if not dedup:
             return succs
         out: List[State] = []
+        buckets: Dict[tuple, List[State]] = {}
         for s in succs:
-            if not any(get_equivalence(s, t) for t in out):
+            key = sequence_mod.canonical_key(s.sequence)
+            bucket = buckets.setdefault(key, [])
+            if not any(get_equivalence(s, t) for t in bucket):
+                bucket.append(s)
                 out.append(s)
         return out
 

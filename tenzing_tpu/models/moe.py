@@ -325,4 +325,7 @@ def make_moe_buffers(
         sel = expert == e
         h = _gelu(x64[sel] @ w1[e].astype(np.float64))
         want[sel] = gate[sel, None] * (h @ w2[e].astype(np.float64))
-    return bufs, specs, want.astype(np.float32)
+    # expected cast to the workload dtype (ADVICE r2) so a bf16 config
+    # compares bf16-vs-bf16; callers comparing a non-f32 config must
+    # choose tolerances to match (~0.4% relative at bf16)
+    return bufs, specs, want.astype(dt)

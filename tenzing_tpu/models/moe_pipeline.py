@@ -412,7 +412,7 @@ def make_pipe_buffers(
             sel = expert == e
             h = gelu_tanh(x[sel].astype(np.float64) @ w1[e].astype(np.float64))
             want64[sel] = gate[sel, None] * (h @ w2[e].astype(np.float64))
-        want = want64.astype(np.float32)
+        want = want64.astype(dt)  # workload dtype (ADVICE r2)
     return bufs, want, cap
 
 

@@ -493,7 +493,7 @@ def make_train_buffers(
         specs[f"stash_z_{c}"] = P(AXIS, None, None)
         bufs[f"dW_{c}"] = np.zeros((s, d, d), dt)
         specs[f"dW_{c}"] = P(AXIS, None, None)
-    return bufs, specs, dw.astype(np.float32)
+    return bufs, specs, dw.astype(dt)  # expected in workload dtype (ADVICE r2)
 
 
 def make_pipeline_buffers(
@@ -532,6 +532,6 @@ def make_pipeline_buffers(
         bufs[f"Y_{c}"] = np.zeros((s * mv, b, d), dt)
         specs[f"Y_{c}"] = P(AXIS, None, None)
 
-    want = np.zeros((s * m, b, d), np.float32)
-    want[(s - 1) * m : s * m] = y.astype(np.float32)  # last stage's block
+    want = np.zeros((s * m, b, d), dt)
+    want[(s - 1) * m : s * m] = y.astype(dt)  # last stage's block
     return bufs, specs, want

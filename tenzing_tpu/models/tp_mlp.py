@@ -205,5 +205,5 @@ def make_tp_mlp_buffers(
                         for c in range(v)])
         for j in range(n_dp)
     ])
-    want = np.tile(per_tp.astype(np.float32), (args.n_tp, 1))
+    want = np.tile(per_tp.astype(dt), (args.n_tp, 1))  # workload dtype (ADVICE r2)
     return bufs, specs, want

@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from tenzing_tpu.ops.common import out_struct
 
@@ -70,7 +71,10 @@ def ffn_pallas(
     return out[:n] if pad else out
 
 
-def _ffn_batched_kernel(x_ref, w1_ref, w2_ref, y_out):
+def _ffn_batched_kernel(x_ref, w1_ref, w2_ref, y_out, acc):
+    # cross-k partial sums accumulate in a f32 scratch, cast to the output
+    # dtype only once at the last hidden tile — a bf16 caller keeps the f32
+    # precision the preferred_element_type matmuls bought (ADVICE r2)
     k = pl.program_id(2)
     x = x_ref[0]  # (bm, d) one expert's row tile
     h = jax.nn.gelu(
@@ -78,15 +82,19 @@ def _ffn_batched_kernel(x_ref, w1_ref, w2_ref, y_out):
     )
     contrib = jnp.dot(
         h.astype(x.dtype), w2_ref[0], preferred_element_type=jnp.float32
-    ).astype(y_out.dtype)
+    )
 
     @pl.when(k == 0)
     def _init():
-        y_out[0] = contrib
+        acc[...] = contrib
 
     @pl.when(k != 0)
     def _accum():
-        y_out[0] += contrib
+        acc[...] += contrib
+
+    @pl.when(k == pl.num_programs(2) - 1)
+    def _flush():
+        y_out[0] = acc[...].astype(y_out.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -134,6 +142,7 @@ def ffn_pallas_batched(
         ],
         out_specs=pl.BlockSpec((1, bm, d), lambda i, j, k: (i, j, 0)),
         out_shape=out_struct((e, cp, d), x.dtype, x, w1, w2),
+        scratch_shapes=[pltpu.VMEM((bm, d), jnp.float32)],
         interpret=interpret,
     )(x, w1, w2)
     return out[:, :c] if pad else out

@@ -310,6 +310,11 @@ class TraceExecutor:
             )
         return fn
 
+    def program(self, order: Sequence) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        """The (unjitted) traced program for a schedule — the public surface
+        for compile checks and external jitting (the driver's ``entry()``)."""
+        return self._build(order)
+
     def compile(self, order: Sequence) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
         """One jitted program per schedule, cached by schedule JSON."""
         key = sequence_to_json_str(order)

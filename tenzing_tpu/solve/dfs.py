@@ -82,17 +82,21 @@ def _dfs_terminals(
 ) -> List[State]:
     """Worklist DFS over ``State.frontier`` (reference get_all_sequences,
     dfs.cpp:16-82; the per-expansion dedup is dfs.cpp:46-58).  With
-    ``dedup_terminals`` the cap counts bijection-unique terminals."""
+    ``dedup_terminals`` the cap counts bijection-unique terminals, recognized
+    by O(1) ``canonical_key`` lookups (equivalent to the reference's pairwise
+    bijection scan — canonical keys are equal iff a lane/event bijection
+    exists; agreement is property-tested in tests/test_dedup_canonical.py)."""
     terminals: List[State] = []
+    seen_keys: set = set()
     stack: List[State] = [State(graph)]
     while stack and len(terminals) < max_seqs:
         st = stack.pop()
         if st.is_terminal():
-            if dedup_terminals and any(
-                sequence_mod.get_equivalence(st.sequence, u.sequence)
-                for u in terminals
-            ):
-                continue
+            if dedup_terminals:
+                key = sequence_mod.canonical_key(st.sequence)
+                if key in seen_keys:
+                    continue
+                seen_keys.add(key)
             terminals.append(st)
             continue
         stack.extend(st.frontier(platform))
@@ -186,13 +190,17 @@ def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000) -> List[S
 
 
 def _dedup_terminal_states(states: List[State]) -> List[State]:
-    """Pairwise dedup of completed schedules under resource bijection
-    (reference dfs.hpp:88-113)."""
+    """Dedup of completed schedules under resource bijection (reference
+    dfs.hpp:88-113) — by O(1) ``canonical_key`` bucket instead of the
+    reference's O(n^2) pairwise bijection scan (equivalent by the canonical-key
+    theorem, core/sequence.py; property-tested in
+    tests/test_dedup_canonical.py)."""
     uniq: List[State] = []
+    seen: set = set()
     for s in states:
-        if not any(
-            sequence_mod.get_equivalence(s.sequence, u.sequence) for u in uniq
-        ):
+        key = sequence_mod.canonical_key(s.sequence)
+        if key not in seen:
+            seen.add(key)
             uniq.append(s)
     return uniq
 

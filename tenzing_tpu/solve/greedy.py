@@ -51,8 +51,12 @@ def greedy_phase_order(graph: Graph, platform, phases: Seq[str]) -> Sequence:
             opname = assigns[0].op.name()
             lane = platform.lanes[lane_rr % len(platform.lanes)]
             lane_rr += 1
+            # fall back to any offered AssignLane for the op if the round-robin
+            # lane is not among the offered decisions (a platform may expose an
+            # op on a lane subset; ADVICE r2)
             d = next(
-                d for d in assigns if d.op.name() == opname and d.lane == lane
+                (d for d in assigns if d.op.name() == opname and d.lane == lane),
+                assigns[0],
             )
             st = st.apply(d)
             continue

@@ -1,0 +1,128 @@
+"""Canonical-key dedup agrees with the pairwise bijection scan.
+
+VERDICT r2 weak #5: ``solve/dfs.py`` and ``core/state.py`` paid O(n^2)
+pairwise ``get_equivalence`` scans although ``canonical_key``
+(core/sequence.py:123) decides sequence bijection-equivalence in O(1) per
+lookup.  These tests pin the replacement to the semantic ground truth: on
+graphs whose enumeration mixes lane bindings, sync events and parallel
+branches, the canonical-key dedup keeps exactly one representative per
+pairwise-equivalence class (reference dedup semantics dfs.hpp:88-113,
+state.cpp:121).
+"""
+
+import itertools
+import random
+
+import pytest
+
+from tenzing_tpu.core import sequence as sequence_mod
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import DeviceOp, NoOp
+from tenzing_tpu.core.resources import Lane
+from tenzing_tpu.core.sequence import Sequence, canonical_key
+from tenzing_tpu.core.state import State
+from tenzing_tpu.solve.dfs import (
+    _dedup_terminal_states,
+    get_all_sequences,
+    get_unique_sequences,
+)
+
+
+class KOp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+class FakePlatform:
+    def __init__(self, n):
+        self.lanes = [Lane(i) for i in range(n)]
+
+
+def fork_graph(n_dev: int = 2, n_cpu: int = 1) -> Graph:
+    """n_dev independent device ops (lane choices + cross-lane syncs) plus
+    n_cpu independent host ops — a space with many bijection duplicates."""
+    g = Graph()
+    for i in range(n_dev):
+        op = KOp(f"k{i}")
+        g.start_then(op)
+        g.then_finish(op)
+    for i in range(n_cpu):
+        op = NoOp(f"c{i}")
+        g.start_then(op)
+        g.then_finish(op)
+    return g
+
+
+def pairwise_unique(seqs):
+    """The ground-truth dedup: first representative per pairwise class."""
+    uniq = []
+    for s in seqs:
+        if not any(sequence_mod.get_equivalence(s, u) for u in uniq):
+            uniq.append(s)
+    return uniq
+
+
+@pytest.mark.parametrize("n_dev,n_cpu,n_lanes", [(1, 1, 2), (2, 0, 2), (2, 1, 2), (3, 0, 3)])
+def test_terminal_dedup_matches_pairwise(n_dev, n_cpu, n_lanes):
+    g = fork_graph(n_dev, n_cpu)
+    plat = FakePlatform(n_lanes)
+    raw = [st.sequence for st in get_all_sequences(g, plat, max_seqs=5000)]
+    want = pairwise_unique(raw)
+    got = [st.sequence for st in get_unique_sequences(g, plat, max_seqs=5000)]
+    # same class count, and classes correspond 1:1 under pairwise equivalence
+    assert len(got) == len(want)
+    for s in got:
+        assert any(sequence_mod.get_equivalence(s, w) for w in want)
+    for w in want:
+        assert any(sequence_mod.get_equivalence(w, s) for s in got)
+
+
+def test_dedup_terminal_states_matches_pairwise():
+    g = fork_graph(2, 1)
+    plat = FakePlatform(2)
+    states = get_all_sequences(g, plat, max_seqs=5000)
+    got = _dedup_terminal_states(states)
+    want = pairwise_unique([st.sequence for st in states])
+    assert len(got) == len(want)
+    for st in got:
+        assert any(sequence_mod.get_equivalence(st.sequence, w) for w in want)
+
+
+def test_canonical_key_iff_equivalence_random_orders():
+    """Property check on random op orders: keys equal <=> bijection exists."""
+    g = fork_graph(2, 1)
+    plat = FakePlatform(2)
+    seqs = [st.sequence for st in get_all_sequences(g, plat, max_seqs=5000)]
+    rng = random.Random(0)
+    sample = rng.sample(seqs, min(20, len(seqs)))
+    for a, b in itertools.combinations(sample, 2):
+        eq = bool(sequence_mod.get_equivalence(a, b))
+        assert (canonical_key(a) == canonical_key(b)) == eq
+
+
+def test_frontier_dedup_matches_pairwise():
+    """State.frontier's bucketed dedup = the unbucketed pairwise dedup."""
+    from tenzing_tpu.core.state import get_equivalence as state_eq
+
+    g = fork_graph(2, 1)
+    plat = FakePlatform(2)
+    # walk a few levels, comparing bucketed vs pairwise dedup at each step
+    level = [State(g)]
+    for _ in range(4):
+        nxt = []
+        for st in level:
+            if st.is_terminal():
+                continue
+            succs = st.frontier(plat, dedup=False)
+            want = []
+            for s in succs:
+                if not any(state_eq(s, t) for t in want):
+                    want.append(s)
+            got = st.frontier(plat, dedup=True)
+            assert len(got) == len(want)
+            for s in got:
+                assert any(state_eq(s, t) for t in want)
+            nxt.extend(got)
+        level = nxt[:6]  # keep the walk small
+        if not level:
+            break
