@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tenzing_tpu.core.graph import Graph
-from tenzing_tpu.core.operation import CompoundOp, DeviceOp
+from tenzing_tpu.core.operation import ChoiceOp, CompoundOp, DeviceOp
 
 # the six face directions (reference loops dx,dy,dz with exactly_one,
 # ops_halo_exchange.cu:29-31,57-144)
@@ -143,6 +143,60 @@ class Exchange(DeviceOp):
         return {f"recv_{name}": jax.lax.ppermute(bufs[f"buf_{name}"], axis, perm)}
 
 
+class ExchangeXla(Exchange):
+    """The XLA collective-permute exchange under a menu-distinct name."""
+
+    def __init__(self, d: Tuple[int, int, int]):
+        super().__init__(d)
+        self._name = f"exchange_{dir_name(d)}.xla"
+
+
+class ExchangeDma(Exchange):
+    """Menu alternative: the same neighbor shift issued as a per-neighbor
+    Pallas remote DMA (``make_async_remote_copy`` + neighbor barrier,
+    ops/rdma.py) — the TPU analog of the reference's per-rank negotiated
+    Isend/Irecv exchange (row_part_spmv.cuh:259-423, ops_mpi.hpp:17-146)
+    rather than a compiler-scheduled collective."""
+
+    def __init__(self, d: Tuple[int, int, int]):
+        super().__init__(d)
+        self._name = f"exchange_{dir_name(d)}.rdma"
+
+    def apply(self, bufs, ctx):
+        from tenzing_tpu.ops.rdma import rdma_shift_fused
+
+        i = [j for j, v in enumerate(self._d) if v != 0][0]
+        axis = _AXIS_NAMES[i]
+        sign = sum(self._d)
+        name = dir_name(self._d)
+        axes = tuple(getattr(ctx, "axis_names", ()) or ())
+        return {
+            f"recv_{name}": rdma_shift_fused(
+                bufs[f"buf_{name}"], axes, axis if axes else None,
+                1 if sign > 0 else -1,
+                # barrier semaphores are shared by collective id: one id per
+                # direction keeps six concurrent exchanges from cross-talking
+                collective_id=DIRECTIONS.index(tuple(self._d)),
+            )
+        }
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
+class ExchangeChoice(ChoiceOp):
+    """XLA collective-permute vs Pallas remote-DMA for one direction's
+    neighbor exchange — the transfer-engine half of the searched menu (the
+    kernel half is ops/halo_pallas.py's pack/unpack choice)."""
+
+    def __init__(self, d: Tuple[int, int, int]):
+        super().__init__(f"exchange_{dir_name(d)}")
+        self._d = tuple(d)
+
+    def choices(self):
+        return [ExchangeXla(self._d), ExchangeDma(self._d)]
+
+
 class Unpack(DeviceOp):
     """Write the received face into the ghost shell (reference Unpack,
     ops_halo_exchange.hpp:143-186, kernels ops_halo_exchange.cu:611-699 — and
@@ -184,13 +238,18 @@ def add_to_graph(
     args: HaloArgs,
     preds: Optional[List] = None,
     succs: Optional[List] = None,
+    xfer_choice: bool = False,
 ) -> Graph:
     """Build the per-direction pack -> exchange -> unpack chains (reference
-    HaloExchange::add_to_graph, ops_halo_exchange.cu:33-257)."""
+    HaloExchange::add_to_graph, ops_halo_exchange.cu:33-257).  With
+    ``xfer_choice`` each exchange is a ChoiceOp over the transfer-engine menu
+    (XLA collective-permute vs Pallas remote DMA) — same flag name as the
+    pipelined halo's transfer menu (halo_pipeline.add_to_graph)."""
     preds = preds if preds is not None else [g.start()]
     succs = succs if succs is not None else [g.finish()]
     for d in DIRECTIONS:
-        pack, exch, unpack = Pack(args, d), Exchange(d), Unpack(args, d)
+        exch = ExchangeChoice(d) if xfer_choice else Exchange(d)
+        pack, unpack = Pack(args, d), Unpack(args, d)
         for p in preds:
             g.then(p, pack)
         g.then(pack, exch)

@@ -49,7 +49,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tenzing_tpu.core.graph import Graph
-from tenzing_tpu.core.operation import Finish, Start
+from tenzing_tpu.core.operation import ChoiceOp, CompoundOp, Finish, Start
 from tenzing_tpu.core.sequence import Sequence
 from tenzing_tpu.models.halo import (
     DIRECTIONS,
@@ -116,8 +116,57 @@ class UnpackRecv(Unpack):
         return {"U": lax.dynamic_update_slice(bufs["U"], face, starts)}
 
 
-def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = False):
-    """The 5-op chain for one face direction."""
+class HostRoundTrip(CompoundOp):
+    """The host-staged transfer as one expandable vertex: post the
+    device->host spill, then the host->device fetch — the non-GPU-aware-MPI
+    staging analog, packaged so it can sit in a ChoiceOp next to the
+    device-resident RDMA alternative."""
+
+    def __init__(self, name: str, dname: str, buf: str, host: str, recv: str):
+        super().__init__(name)
+        self._dname = dname
+        self._buf, self._host, self._recv = buf, host, recv
+
+    def graph(self) -> Graph:
+        g = Graph()
+        spill = HostSpillStart(f"spill_{self._dname}", self._buf, self._host)
+        fetch = HostFetchStart(f"fetch_{self._dname}", self._host, self._recv)
+        g.start_then(spill)
+        g.then(spill, fetch)
+        g.then_finish(fetch)
+        return g
+
+
+class TransferChoice(ChoiceOp):
+    """The transfer-engine menu for one direction's network hop: the
+    host-staged round trip (PCIe + host memory, the non-CUDA-aware staging
+    analog) vs a device-resident RDMA copy (the chip's DMA engine, the
+    CUDA-aware analog — SURVEY §7.0's 'device buffers addressed by ICI DMA').
+    Which engine, like which kernel, is the solver's question."""
+
+    def __init__(self, d: Tuple[int, int, int]):
+        name = dir_name(d)
+        super().__init__(f"xfer_{name}")
+        self._d = tuple(d)
+
+    def choices(self) -> List:
+        from tenzing_tpu.ops.rdma import RdmaCopyStart
+
+        name = dir_name(self._d)
+        return [
+            HostRoundTrip(
+                f"xfer_{name}.host", name, f"buf_{name}", f"host_{name}",
+                f"recv_{name}"
+            ),
+            RdmaCopyStart(f"xfer_{name}.rdma", f"buf_{name}", f"recv_{name}"),
+        ]
+
+
+def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = False,
+                  xfer_choice: bool = False):
+    """The op chain for one face direction: (pack, transfer ops, await,
+    unpack).  ``impl_choice`` turns pack/unpack into the kernel menu;
+    ``xfer_choice`` turns the spill+fetch pair into the transfer-engine menu."""
     name = dir_name(d)
     if impl_choice:
         from tenzing_tpu.ops.halo_pallas import PackChoice, UnpackChoice
@@ -127,10 +176,15 @@ def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = F
     else:
         pack = PackFlat(args, d)
         unpack = UnpackRecv(args, d)
-    spill = HostSpillStart(f"spill_{name}", f"buf_{name}", f"host_{name}")
-    fetch = HostFetchStart(f"fetch_{name}", f"host_{name}", f"recv_{name}")
+    if xfer_choice:
+        xfer: Tuple = (TransferChoice(d),)
+    else:
+        xfer = (
+            HostSpillStart(f"spill_{name}", f"buf_{name}", f"host_{name}"),
+            HostFetchStart(f"fetch_{name}", f"host_{name}", f"recv_{name}"),
+        )
     await_ = AwaitTransfer(f"await_{name}", f"recv_{name}")
-    return pack, spill, fetch, await_, unpack
+    return (pack,) + xfer + (await_, unpack)
 
 
 def add_to_graph(
@@ -139,26 +193,28 @@ def add_to_graph(
     preds: Optional[List] = None,
     succs: Optional[List] = None,
     impl_choice: bool = False,
+    xfer_choice: bool = False,
 ) -> Graph:
-    """Six independent pack -> spill -> fetch -> await -> unpack chains
+    """Six independent pack -> transfer -> await -> unpack chains
     (reference HaloExchange::add_to_graph shape, ops_halo_exchange.cu:33-257)."""
     preds = preds if preds is not None else [g.start()]
     succs = succs if succs is not None else [g.finish()]
     for d in DIRECTIONS:
-        pack, spill, fetch, await_, unpack = direction_ops(args, d, impl_choice)
+        ops = direction_ops(args, d, impl_choice, xfer_choice)
+        pack, unpack = ops[0], ops[-1]
         for p in preds:
             g.then(p, pack)
-        g.then(pack, spill)
-        g.then(spill, fetch)
-        g.then(fetch, await_)
-        g.then(await_, unpack)
+        for a, b in zip(ops, ops[1:]):
+            g.then(a, b)
         for s in succs:
             g.then(unpack, s)
     return g
 
 
-def build_graph(args: HaloArgs, impl_choice: bool = False) -> Graph:
-    return add_to_graph(Graph(), args, impl_choice=impl_choice)
+def build_graph(args: HaloArgs, impl_choice: bool = False,
+                xfer_choice: bool = False) -> Graph:
+    return add_to_graph(Graph(), args, impl_choice=impl_choice,
+                        xfer_choice=xfer_choice)
 
 
 def naive_order(args: HaloArgs, platform) -> Sequence:

@@ -255,10 +255,41 @@ class A2AExchange(CompoundOp):
         return g
 
 
+class RdmaExchange(CompoundOp):
+    """Exchange via one gather -> remote-DMA-start -> await chain per retained
+    cyclic distance: each shard DMA-writes its negotiated column block into
+    its ``+d`` neighbor's receive buffer (ops/rdma.py ``RdmaShiftStart``) —
+    the per-neighbor computed-offset DMA that is the TPU analog of the
+    reference's negotiated Isend/Irecv exchange (row_part_spmv.cuh:259-423),
+    vs the compiler-scheduled collective of :class:`PermuteExchange`."""
+
+    def __init__(self, steps: List[int], name: str = "exchange.rdma"):
+        super().__init__(name)
+        self._steps = list(steps)
+
+    def graph(self) -> Graph:
+        from tenzing_tpu.ops.rdma import RdmaShiftStart
+
+        g = Graph()
+        for d in self._steps:
+            gather = GatherSend(f"gather_{d}", d)
+            post = RdmaShiftStart(
+                f"rdma_{d}", f"send_{d}", f"recv_{d}", axis="sp", shift=d,
+                collective_id=d,
+            )
+            await_ = AwaitTransfer(f"await_{d}", f"recv_{d}")
+            g.start_then(gather)
+            g.then(gather, post)
+            g.then(post, await_)
+            g.then_finish(await_)
+        return g
+
+
 class ExchangeChoice(ChoiceOp):
     """The exchange-implementation menu: per-distance permutes vs one padded
-    all-to-all — which wins depends on how many distances are live and how
-    ragged the lists are, so it is the solver's question."""
+    all-to-all vs per-distance remote DMA — which wins depends on how many
+    distances are live and how ragged the lists are, so it is the solver's
+    question."""
 
     def __init__(self, steps: List[int], widths: Dict[int, int],
                  name: str = "exchange"):
@@ -270,6 +301,7 @@ class ExchangeChoice(ChoiceOp):
         return [
             PermuteExchange(self._steps),
             A2AExchange(self._steps, self._widths),
+            RdmaExchange(self._steps),
         ]
 
 

@@ -213,6 +213,16 @@ class PsumStart(CommStart):
         return j
 
 
+def _settle_inflight(tc, name: str) -> None:
+    """If ``name`` has an explicit in-flight completion handle (split-kernel
+    RDMA, ops/rdma.py), run its wait kernel now: the buffer value becomes the
+    *completed* destination and downstream consumers (and the host-chain join)
+    depend on the semaphore wait, not merely on the post."""
+    pending = getattr(tc, "inflight", {}).pop(name, None)
+    if pending is not None:
+        tc.bufs[name] = pending(tc.bufs[name])
+
+
 @register_kind("await_transfer")
 class AwaitTransfer(CpuOp):
     """Wait for an in-flight buffer: joins its completion into the host chain
@@ -237,6 +247,7 @@ class AwaitTransfer(CpuOp):
             # completion handle; with SSA buffers a spill needs no wait for
             # source reuse anyway — await the round-trip's fetch result instead
             return
+        _settle_inflight(tc, self._buf)
         tc._host_tok = tc._join(tc._host_tok, _clean(_scalarize(tc.bufs[self._buf])))
 
     def to_json(self) -> Dict[str, Any]:
@@ -261,6 +272,9 @@ class MultiAwait(CpuOp):
     def trace(self, tc) -> None:
         from tenzing_tpu.runtime.executor import _clean, _scalarize
 
+        for b in self._bufs:
+            if b not in tc.host_space:
+                _settle_inflight(tc, b)
         toks = [
             _clean(_scalarize(tc.bufs[b])) for b in self._bufs if b not in tc.host_space
         ]

@@ -109,6 +109,13 @@ class TraceContext:
         # measured: host-side add/reshape/slice fail to compile), so ties,
         # awaits and fences must skip them
         self.host_space: set = set(host_space) if host_space else set()
+        # in-flight transfers with an explicit completion handle: buffer name
+        # -> closure(value) that blocks on the transfer's semaphores and
+        # returns the completed value (split-kernel RDMA, ops/rdma.py).
+        # Transient within one trace: the posting op stashes the closure, the
+        # awaiting op settles it — a schedule always contains both, so nothing
+        # here ever crosses the benchmark loop's carry.
+        self.inflight: Dict[str, Any] = {}
         self._zero = jnp.zeros((), jnp.float32)
         if tokens is None:
             self._lane_tok: Dict[int, Any] = {}

@@ -1,0 +1,166 @@
+"""Remote-DMA comm ops (VERDICT r2 item 2): the make_async_remote_copy +
+semaphore realization of Isend/Irecv/Wait (reference ops_mpi.hpp:17-146),
+exercised in Pallas TPU-interpret mode on the virtual CPU mesh — kernel
+numerics, the menu wiring in the halo and pipeline graphs, and the executor's
+split start/await settlement plumbing."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.core.state import ChooseOp, State
+from tenzing_tpu.ops.rdma import RdmaCopyStart, rdma_shift_fused
+from tenzing_tpu.runtime.executor import TraceExecutor
+
+
+def test_shift_fused_matches_roll_1d():
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("x",))
+    x = jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)
+
+    @jax.jit
+    def f(x):
+        return jax.shard_map(
+            lambda v: rdma_shift_fused(v, ("x",), "x", 1, collective_id=1),
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+        )(x)
+
+    np.testing.assert_array_equal(np.asarray(f(x)), np.roll(np.asarray(x), 1, 0))
+
+
+@pytest.mark.parametrize("axis,dim", [("x", 0), ("y", 1), ("z", 2)])
+def test_shift_fused_matches_roll_3d_mesh(axis, dim):
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("x", "y", "z"))
+    x = jnp.arange(2 * 2 * 2 * 16, dtype=jnp.float32).reshape(2, 2, 2, 16)
+
+    @jax.jit
+    def f(x):
+        return jax.shard_map(
+            lambda v: rdma_shift_fused(v, ("x", "y", "z"), axis, 1, collective_id=2),
+            mesh=mesh, in_specs=P("x", "y", "z"), out_specs=P("x", "y", "z"),
+            check_vma=False,
+        )(x)
+
+    np.testing.assert_array_equal(
+        np.asarray(f(x)), np.roll(np.asarray(x), 1, dim)
+    )
+
+
+def test_shift_axis_size_one_is_loopback_copy():
+    """n=1 degenerates to the self copy (no barrier, the single-chip case)."""
+    devs = np.array(jax.devices()[:1])
+    mesh = Mesh(devs, ("x",))
+    x = jnp.arange(32, dtype=jnp.float32).reshape(2, 16)
+
+    @jax.jit
+    def f(x):
+        return jax.shard_map(
+            lambda v: rdma_shift_fused(v, ("x",), "x", 1),
+            mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False,
+        )(x)
+
+    np.testing.assert_array_equal(np.asarray(f(x)), np.asarray(x))
+
+
+def _choose_all(g: Graph, plat, suffix: str) -> State:
+    """Drive the SDP to a terminal state, preferring the ``suffix`` choice at
+    every ChoiceOp and the first decision otherwise."""
+    st = State(g)
+    while not st.is_terminal():
+        ds = st.get_decisions(plat)
+        pick = next(
+            (d for d in ds if isinstance(d, ChooseOp)
+             and d.choice.name().endswith(suffix)),
+            ds[0],
+        )
+        st = st.apply(pick)
+    return st
+
+
+def _pipeline_fixture():
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.models.halo_pipeline import (
+        build_graph,
+        host_buffer_names,
+        make_pipeline_buffers,
+    )
+
+    args = HaloArgs(nq=2, lx=4, ly=4, lz=4, radius=1)
+    bufs, want = make_pipeline_buffers(args, seed=0)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    g = build_graph(args, xfer_choice=True)
+    plat = Platform.make_n_lanes(2)
+    return g, jbufs, want, plat, args
+
+
+@pytest.mark.parametrize("engine", [".host", ".rdma"])
+def test_pipeline_transfer_menu_both_engines_correct(engine):
+    """The halo pipeline's transfer-engine ChoiceOp: both the host round trip
+    and the device-resident RDMA copy must produce the exchanged grid."""
+    g, jbufs, want, plat, args = _pipeline_fixture()
+    st = _choose_all(g, plat, engine)
+    names = [op.desc() for op in st.sequence.vector()]
+    if engine == ".rdma":
+        assert any("xfer_" in n and ".rdma" in n for n in names)
+        assert not any(n.startswith("spill_") for n in names)
+    else:
+        assert any(n.startswith("spill_") for n in names)
+    ex = TraceExecutor(plat, jbufs)
+    out = ex.run(st.sequence)
+    r = args.radius
+    U = np.asarray(out["U"])
+    np.testing.assert_allclose(
+        U[:, : args.lx + 2 * r, : args.ly + 2 * r, : args.lz + 2 * r],
+        want[:, : args.lx + 2 * r, : args.ly + 2 * r, : args.lz + 2 * r],
+    )
+
+
+def test_pipeline_rdma_benchmark_loop_runs():
+    """The split/fused RDMA path must survive the benchmark hot loop's
+    fori_loop carry (prepare_n): the inflight closure settles within one
+    iteration and nothing leaks into the carry."""
+    g, jbufs, want, plat, args = _pipeline_fixture()
+    st = _choose_all(g, plat, ".rdma")
+    ex = TraceExecutor(plat, jbufs)
+    run_n = ex.prepare_n(st.sequence)
+    run_n(2)  # raises on any carry-structure mismatch
+
+
+def test_halo_mesh_exchange_menu_both_engines_correct():
+    """The mesh halo's exchange ChoiceOp (XLA collective-permute vs Pallas
+    remote DMA) — both engines fill every ghost face with the periodic
+    neighbor's interior edge on the 2x2x2 mesh."""
+    from tenzing_tpu.models.halo import HaloArgs, add_to_graph, make_halo_buffers
+    from tenzing_tpu.solve.dfs import structural_variants
+
+    args = HaloArgs(nq=1, lx=2, ly=2, lz=2, radius=1)
+    bufs, specs, want = make_halo_buffers((2, 2, 2), args, seed=0)
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("x", "y", "z"))
+    plat = Platform.make_n_lanes(1, mesh=mesh, specs=specs)
+    g = add_to_graph(Graph(), args, xfer_choice=True)
+    ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+    for engine in (".xla", ".rdma"):
+        st = _choose_all(g, plat, engine)
+        assert any(engine in op.desc() for op in st.sequence.vector())
+        out = ex.run(st.sequence)
+        np.testing.assert_allclose(np.asarray(out["U"]), want)
+
+
+def test_rdma_copy_start_serdes_roundtrip():
+    """Graph-anchored serdes finds the RDMA op inside the ChoiceOp menu."""
+    from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
+
+    g, jbufs, want, plat, args = _pipeline_fixture()
+    st = _choose_all(g, plat, ".rdma")
+    payload = sequence_to_json(st.sequence)
+    back = sequence_from_json(payload, g)
+    assert [o.desc() for o in back.vector()] == [
+        o.desc() for o in st.sequence.vector()
+    ]

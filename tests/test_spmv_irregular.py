@@ -97,11 +97,12 @@ def test_block_diagonal_needs_no_exchange():
     np.testing.assert_allclose(outs[0], want, rtol=2e-3)
 
 
-def test_exchange_impl_choice_both_variants_correct():
+def test_exchange_impl_choice_all_variants_correct():
     """With impl_choice the exchange realization is a ChoiceOp: per-distance
     permutes vs one padded all-to-all (the Ialltoallv analog,
-    ops_mpi.hpp:82-119).  Both structural variants must be enumerated and
-    produce the right Y."""
+    ops_mpi.hpp:82-119) vs per-distance remote DMA (the negotiated
+    Isend/Irecv analog, row_part_spmv.cuh:259-423).  Every structural variant
+    must be enumerated and produce the right Y."""
     from tenzing_tpu.solve.dfs import structural_variants
 
     a = random_matrix(64, 64, 500, seed=9)
@@ -112,13 +113,14 @@ def test_exchange_impl_choice_both_variants_correct():
     g.start_then(IrregularSpMV(plan.steps, widths=plan.widths, impl_choice=True))
     g.then_finish(IrregularSpMV(plan.steps, widths=plan.widths, impl_choice=True))
     variants = structural_variants(g)
-    assert len(variants) == 2
-    names = {
-        frozenset(v.desc() for v in var.vertices() if "a2a" in v.desc())
+    assert len(variants) == 3
+    kinds = {
+        ("a2a" if any("a2a" in v.desc() for v in var.vertices())
+         else "rdma" if any("rdma" in v.desc() for v in var.vertices())
+         else "permute")
         for var in variants
     }
-    assert frozenset() in names  # the permute variant has no a2a ops
-    assert any(ns for ns in names)  # and the a2a variant does
+    assert kinds == {"permute", "a2a", "rdma"}
 
     devs = np.array(jax.devices()[:8]).reshape(2, 4)
     mesh = Mesh(devs, ("dp", "sp"))
