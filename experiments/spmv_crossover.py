@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""SpMV transfer-bound crossover sweep (VERDICT r2 item 7).
+
+Round 2's distributed-SpMV verdict was a NULL result at exactly one point in
+config space (paired 0.999 at m=150k, band bw=m/8: the host-staged x exchange
+is too small relative to the local compute for any schedule to hide).  This
+sweep scales the exchange by widening the band — remote columns grow with the
+half-width — and runs the full anytime driver (bench.py: MCTS search, paired
+screen, paired final verdict) at each point, recording where schedule search
+starts to pay: the measured crossover boundary, replacing the bare null.
+
+Writes experiments/SPMV_CROSSOVER.json and one recorded search DB per config
+(spmv_crossover_bw*.csv).  Run on the real chip; ~10 min per point.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).parent
+REPO = HERE.parent
+
+M = 150_000
+FRACTIONS = (0.125, 0.5, 1.0)  # band half-width as a fraction of m
+
+
+def run_point(frac: float, mcts_iters: int) -> dict:
+    bw = int(M * frac)
+    csv = HERE / f"spmv_crossover_bw{bw}.csv"
+    cmd = [
+        sys.executable, str(REPO / "bench.py"), "--workload", "spmv",
+        "--m", str(M), "--spmv-bw", str(bw),
+        "--mcts-iters", str(mcts_iters), "--dump-csv", str(csv),
+    ]
+    sys.stderr.write("+ " + " ".join(cmd) + "\n")
+    out = subprocess.run(cmd, capture_output=True, text=True, cwd=str(REPO))
+    sys.stderr.write(out.stderr[-2000:] + "\n")
+    lines = out.stdout.strip().splitlines()
+    if out.returncode != 0 or not lines:
+        # record the failure point; never lose the points already measured
+        return {"bw": bw, "bw_frac": frac, "csv": csv.name,
+                "rc": out.returncode, "error": out.stderr[-500:]}
+    rec = json.loads(lines[-1])
+    rec.update(bw=bw, bw_frac=frac, csv=csv.name, rc=out.returncode)
+    return rec
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mcts-iters", type=int, default=32)
+    ap.add_argument("--fractions", type=float, nargs="*", default=FRACTIONS)
+    args = ap.parse_args()
+    points = [run_point(f, args.mcts_iters) for f in args.fractions]
+    out = {"m": M, "points": points}
+    (HERE / "SPMV_CROSSOVER.json").write_text(json.dumps(out, indent=1))
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,7 @@ from __future__ import annotations
 import random as _random
 import time
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Protocol, Tuple
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from tenzing_tpu.bench.randomness import is_random
 from tenzing_tpu.core.sequence import Sequence, canonical_key
@@ -239,6 +239,21 @@ class EmpiricalBenchmarker:
             BenchResult.from_times(ts)
             for ts in self.benchmark_batch_times(orders, opts, seed)
         ]
+
+
+class CallableRunner:
+    """ScheduleRunner over *named zero-arg callables* — external baselines
+    (one fused ``jax.nn.dot_product_attention`` call, a single-jit XLA MoE)
+    measured with the SAME protocol as searched schedules, including the
+    decorrelated paired batch: the "order" is just the callable's name.  Each
+    callable must be fully fenced (end with a ``jax.device_get``), mirroring
+    the executor's fetch-fenced runners."""
+
+    def __init__(self, fns: Dict[str, Callable[[], None]]):
+        self.fns = dict(fns)
+
+    def prepare(self, name: str) -> Callable[[], None]:
+        return self.fns[name]
 
 
 class CachingBenchmarker:

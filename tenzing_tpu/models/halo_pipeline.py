@@ -163,10 +163,13 @@ class TransferChoice(ChoiceOp):
 
 
 def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = False,
-                  xfer_choice: bool = False):
+                  xfer_choice: bool = False, engine: str = "host"):
     """The op chain for one face direction: (pack, transfer ops, await,
     unpack).  ``impl_choice`` turns pack/unpack into the kernel menu;
-    ``xfer_choice`` turns the spill+fetch pair into the transfer-engine menu."""
+    ``xfer_choice`` turns the transfer into the engine menu; ``engine``
+    ("host" | "rdma") wires one engine directly when no menu is wanted (the
+    heuristic incumbents pick an engine up front — greedy_phase_order makes
+    no ChooseOp decisions)."""
     name = dir_name(d)
     if impl_choice:
         from tenzing_tpu.ops.halo_pallas import PackChoice, UnpackChoice
@@ -178,6 +181,10 @@ def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = F
         unpack = UnpackRecv(args, d)
     if xfer_choice:
         xfer: Tuple = (TransferChoice(d),)
+    elif engine == "rdma":
+        from tenzing_tpu.ops.rdma import RdmaCopyStart
+
+        xfer = (RdmaCopyStart(f"xfer_{name}.rdma", f"buf_{name}", f"recv_{name}"),)
     else:
         xfer = (
             HostSpillStart(f"spill_{name}", f"buf_{name}", f"host_{name}"),
@@ -194,13 +201,14 @@ def add_to_graph(
     succs: Optional[List] = None,
     impl_choice: bool = False,
     xfer_choice: bool = False,
+    engine: str = "host",
 ) -> Graph:
     """Six independent pack -> transfer -> await -> unpack chains
     (reference HaloExchange::add_to_graph shape, ops_halo_exchange.cu:33-257)."""
     preds = preds if preds is not None else [g.start()]
     succs = succs if succs is not None else [g.finish()]
     for d in DIRECTIONS:
-        ops = direction_ops(args, d, impl_choice, xfer_choice)
+        ops = direction_ops(args, d, impl_choice, xfer_choice, engine)
         pack, unpack = ops[0], ops[-1]
         for p in preds:
             g.then(p, pack)
@@ -212,9 +220,9 @@ def add_to_graph(
 
 
 def build_graph(args: HaloArgs, impl_choice: bool = False,
-                xfer_choice: bool = False) -> Graph:
+                xfer_choice: bool = False, engine: str = "host") -> Graph:
     return add_to_graph(Graph(), args, impl_choice=impl_choice,
-                        xfer_choice=xfer_choice)
+                        xfer_choice=xfer_choice, engine=engine)
 
 
 def naive_order(args: HaloArgs, platform) -> Sequence:
@@ -230,7 +238,7 @@ def naive_order(args: HaloArgs, platform) -> Sequence:
     return Sequence(ops)
 
 
-def greedy_overlap_order(args: HaloArgs, platform) -> Sequence:
+def greedy_overlap_order(args: HaloArgs, platform, engine: str = "host") -> Sequence:
     """The post-all-before-await-any heuristic schedule, derived through the
     SDP machinery so the required sync ops are inserted exactly as the solver
     would.  This is the discipline the *reference's* halo graph hard-codes
@@ -241,9 +249,9 @@ def greedy_overlap_order(args: HaloArgs, platform) -> Sequence:
     from tenzing_tpu.solve.greedy import greedy_phase_order
 
     return greedy_phase_order(
-        build_graph(args),
+        build_graph(args, engine=engine),
         platform,
-        ("start", "pack", "spill", "fetch", "await", "unpack", "finish"),
+        ("start", "pack", "spill", "fetch", "xfer", "await", "unpack", "finish"),
     )
 
 

@@ -1,0 +1,62 @@
+"""Cost models + CallableRunner (VERDICT r2 weak #3: absolute yardsticks)."""
+
+import jax.numpy as jnp
+
+from tenzing_tpu.bench.benchmarker import (
+    BenchOpts,
+    CallableRunner,
+    EmpiricalBenchmarker,
+)
+from tenzing_tpu.bench.roofline import (
+    V5E_PEAK_BF16_FLOPS,
+    attention_cost,
+    halo_cost,
+    moe_cost,
+    spmv_cost,
+)
+
+
+def test_attention_cost_counts_both_matmuls():
+    c = attention_cost(batch=2, seq=1024, head_dim=128)
+    assert c.flops == 4.0 * 2 * 1024 * 1024 * 128
+    assert c.hbm_bytes == 4.0 * 2 * 1024 * 128 * 4
+    u = c.utilization(1e-3)
+    assert abs(u["mxu_frac"] - c.flops / 1e-3 / V5E_PEAK_BF16_FLOPS) < 1e-12
+
+
+def test_moe_cost_staged_adds_transfer_bytes():
+    plain = moe_cost(1024, 64, 256, staged=False)
+    staged = moe_cost(1024, 64, 256, staged=True)
+    assert plain.flops == staged.flops == 4.0 * 1024 * 64 * 256
+    assert plain.xfer_bytes == 0.0
+    assert staged.xfer_bytes == 4.0 * 1024 * 64 * 4
+
+
+def test_halo_cost_is_byte_bound():
+    c = halo_cost(nq=3, lx=512, ly=512, lz=512, radius=3)
+    assert c.flops == 0.0
+    faces = 2 * 3 * (512 * 512 * 3) * 3  # 3 axis pairs x face cells x nq
+    assert c.hbm_bytes == 4.0 * faces * 4
+    assert c.xfer_bytes == 2.0 * faces * 4
+
+
+def test_spmv_cost():
+    c = spmv_cost(m=1000, nnz=10_000)
+    assert c.flops == 20_000
+
+
+def test_callable_runner_measures_named_fns():
+    import jax
+
+    f = jax.jit(lambda x: (x * 2).sum())
+    x = jnp.ones((64,))
+    emp = EmpiricalBenchmarker(CallableRunner({
+        "a": lambda: jax.device_get(f(x)),
+        "b": lambda: jax.device_get(f(x + 1)),
+    }))
+    times = emp.benchmark_batch_times(
+        ["a", "b"], BenchOpts(n_iters=3, target_secs=1e-4), seed=0
+    )
+    assert len(times) == 2 and all(len(ts) == 3 for ts in times)
+    res = emp.benchmark("a", BenchOpts(n_iters=3, target_secs=1e-4))
+    assert res.pct50 > 0
