@@ -17,16 +17,19 @@ Workloads (``--workload``):
   expert-parallel network-hop analog), searched over order x lane x
   expert-kernel (XLA vs Pallas) across independent microbatch chunk chains.
 
-The search is anytime and starts from the naive incumbent: MCTS (FastMin
-strategy) spends a fixed compile budget exploring the schedule space.  The
-verdict comes from a decorrelated *final batch* (reference batch benchmark,
-benchmarker.cpp:21-76): naive and the top distinct candidates are re-measured
-together, visited in a fresh random order per iteration, and ``vs_baseline``
-is the best candidate's **paired per-iteration speedup** over naive (median of
-naive[k]/cand[k] with a bootstrap CI, utils.numeric.paired_speedup) — drift
-common to both schedules cancels instead of masquerading as, or drowning, a
-schedule difference.  vs_baseline >= 1, exceeding 1 exactly when the search
-discovers a schedule faster than naive under the paired measurement.
+The search is anytime: greedy domain incumbents (for halo, an engine x
+lane-count grid) seed an MCTS (FastMin) that explores at CHEAP measurement
+cost — search-time numbers only steer the tree.  Candidate selection and the
+verdict are both *paired decorrelated batches* (reference batch benchmark,
+benchmarker.cpp:21-76): a moderate-cost screen ranks the distinct candidates
+by paired per-iteration speedup vs naive and drops anything below 1.0, then
+the final batch (3x iterations, 20x adaptive measurement floor,
+benchmarker.cpp:83-119) re-measures naive + the top 3 survivors together,
+visited in a fresh random order per iteration.  ``vs_baseline`` is the best
+finalist's **paired speedup** (median of naive[k]/cand[k] with a bootstrap
+CI, utils.numeric.paired_speedup) — drift common to both schedules cancels
+instead of masquerading as, or drowning, a schedule difference; a win
+additionally requires the CI to exclude 1.0.
 
 Prints ONE JSON line:
   {"metric": ..., "value": <best pct50, us>, "unit": "us",
@@ -343,11 +346,21 @@ def main() -> int:
                 ("greedy-overlap", greedy_overlap_order(margs_, cap_, plat))
             ]
             if not args.smoke:
-                # the half-width-transfer incumbent (bf16 staging): the
-                # likely winner the search should start from
+                # the half-width-transfer incumbent (bf16 staging) and the
+                # device-resident-transfer incumbents (rdma engine): the
+                # likely winners the search should start from
                 greedy_seqs.append((
                     "greedy-overlap-bf16",
                     greedy_overlap_order(margs_, cap_, plat, staging="bf16"),
+                ))
+                greedy_seqs.append((
+                    "greedy-bf16-rdma",
+                    greedy_overlap_order(margs_, cap_, plat, staging="bf16",
+                                         engine="rdma"),
+                ))
+                greedy_seqs.append((
+                    "greedy-f32-rdma",
+                    greedy_overlap_order(margs_, cap_, plat, engine="rdma"),
                 ))
         for label, greedy_seq in greedy_seqs:
             t0 = time.time()

@@ -27,32 +27,49 @@ from pathlib import Path
 
 import numpy as np
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-def fenced(f, *args):
-    """Zero-arg callable running jitted ``f`` and fetching one reduced scalar
-    (the executor's fencing discipline, runtime/executor.py prepare_n)."""
+
+def repeat_fenced(body, *args):
+    """``run_n(n)``: n executions of ``body(*args) -> array`` inside ONE
+    compiled program, chained by a datatie so XLA cannot hoist the
+    loop-invariant body, fenced by a device_get of one reduced scalar — the
+    executor's prepare_n discipline for external callables (one tunnel round
+    trip per measurement, however fast the kernel)."""
     import jax
+    import jax.numpy as jnp
+    from jax import lax
 
-    def run():
-        jax.device_get(f(*args))
+    from tenzing_tpu.runtime.executor import _clean, _scalarize, datatie
 
-    return run
+    def step(i, acc):
+        tied = tuple(datatie(a, acc) for a in args)
+        out = body(*tied)
+        return _clean(_scalarize(jnp.sum(out)))
+
+    f_n = jax.jit(lambda n: lax.fori_loop(0, n, step, jnp.zeros((), jnp.float32)))
+    return lambda n: jax.device_get(f_n(jnp.int32(n)))
 
 
-def measure_set(fns: dict, n_iters: int = 30, target_secs: float = 0.1):
-    """Paired decorrelated batch over named callables -> {name: times}."""
+def measure_set(run_ns: dict, n_iters: int = 30, target_secs: float = 0.1):
+    """Paired decorrelated batch over named run_n callables -> {name: times}."""
     from tenzing_tpu.bench.benchmarker import (
         BenchOpts,
         BenchResult,
-        CallableRunner,
         EmpiricalBenchmarker,
+        RepeatCallableRunner,
     )
 
-    emp = EmpiricalBenchmarker(CallableRunner(fns))
-    names = list(fns)
+    emp = EmpiricalBenchmarker(RepeatCallableRunner(run_ns))
+    names = list(run_ns)
+    for nm in names:  # warm/compile one at a time, with visibility
+        t0 = time.time()
+        run_ns[nm](1)
+        sys.stderr.write(f"  warm {nm}: {time.time()-t0:.1f}s\n")
     times = emp.benchmark_batch_times(
         names, BenchOpts(n_iters=n_iters, target_secs=target_secs), seed=11
     )
+    sys.stderr.write("  batch done\n")
     return {n: ts for n, ts in zip(names, times)}, {
         n: BenchResult.from_times(ts) for n, ts in zip(names, times)
     }
@@ -97,39 +114,42 @@ def attn_entry():
         st = st.apply(pick)
     ours_prog = ex.compile(st.sequence)
 
-    def ours_reduced(b):
-        return jnp.sum(ours_prog(b)["O"]).astype(jnp.float32)
-
-    ours = jax.jit(ours_reduced)
-
     b, n, d = aargs.batch, aargs.seq_local * aargs.n_devices, aargs.head_dim
     q4 = jbufs["Q"].reshape(b, n, 1, d)
     k4 = jbufs["K"].reshape(b, n, 1, d)
     v4 = jbufs["V"].reshape(b, n, 1, d)
 
     def fused(q, k, v):
-        o = jax.nn.dot_product_attention(q, k, v, scale=aargs.scale)
-        return jnp.sum(o).astype(jnp.float32)
+        return jax.nn.dot_product_attention(q, k, v, scale=aargs.scale)
 
-    fused_f32 = jax.jit(fused)
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q4, k4, v4))
-    fused_bf16 = jax.jit(fused)
 
-    # numerics: all implementations agree with the dense host reference
-    o_ours = np.asarray(ex.run(st.sequence)["O"])
+    # numerics: our O agrees with the dense host reference (fetch O only —
+    # fetching every buffer through the tunnel costs ~100 MB)
+    sys.stderr.write("attn: numerics check...\n")
+    o_ours = np.asarray(ours_prog(jbufs)["O"])
     np.testing.assert_allclose(o_ours, want, atol=0.05)
+    sys.stderr.write("attn: numerics ok; measuring...\n")
     fns = {
-        "searched_bf16_menu": fenced(ours, jbufs),
-        "xla_fused_f32": fenced(fused_f32, q4, k4, v4),
-        "xla_fused_bf16": fenced(fused_bf16, qb, kb, vb),
+        "searched_bf16_menu": ex.prepare_n(st.sequence),
+        "xla_fused_f32": repeat_fenced(fused, q4, k4, v4),
+        "xla_fused_bf16": repeat_fenced(fused, qb, kb, vb),
     }
     times, results = measure_set(fns)
-    cost = attention_cost(b, n, d)
+    # bytes/element per entry: the bf16 rows hold Q/K/V at 2 bytes (and the
+    # searched menu's bf16 kernel halves the K/V loads) — a single f32 cost
+    # would overstate their HBM utilization 2x
+    costs = {
+        "searched_bf16_menu": attention_cost(b, n, d, bytes_per_el=2),
+        "xla_fused_f32": attention_cost(b, n, d, bytes_per_el=4),
+        "xla_fused_bf16": attention_cost(b, n, d, bytes_per_el=2),
+    }
     entry = {"workload": "blocked_attention", "config": {"b": b, "n": n, "d": d}}
     for name, res in results.items():
         entry[name] = {
             "pct50_ms": res.pct50 * 1e3,
-            **{k: round(v, 4) for k, v in cost.utilization(res.pct50).items()},
+            **{k: round(v, 4)
+               for k, v in costs[name].utilization(res.pct50).items()},
         }
     for name in ("xla_fused_f32", "xla_fused_bf16"):
         m, lo, hi = paired_speedup(times[name], times["searched_bf16_menu"], seed=5)
@@ -161,12 +181,6 @@ def moe_entry():
     plat = Platform.make_n_lanes(2)
     ex = TraceExecutor(plat, jbufs)
     order = greedy_overlap_order(margs, cap, plat, staging="bf16")
-    ours_prog = ex.compile(order)
-
-    def ours_reduced(b):
-        return jnp.sum(ours_prog(b)["Y"]).astype(jnp.float32)
-
-    ours = jax.jit(ours_reduced)
 
     # single-jit XLA MoE: same routing tables, no staging hop — gather,
     # per-expert gelu MLP, weighted scatter, all fused by XLA in one program
@@ -191,15 +205,16 @@ def moe_entry():
                 y.at[idx[c].reshape(-1)].add(
                     w[c].reshape(-1, 1) * out.reshape(-1, margs.d_model))
             )
-        return jnp.sum(jnp.concatenate(ys)).astype(jnp.float32)
+        return jnp.concatenate(ys)
 
-    xla_fn = jax.jit(xla_moe)
-
-    y_ours = np.asarray(ex.run(order)["Y"])
+    sys.stderr.write("moe: numerics check...\n")
+    y_ours = np.asarray(ex.compile(order)(jbufs)["Y"])
     np.testing.assert_allclose(y_ours, want, atol=0.15, rtol=0.05)
+    sys.stderr.write("moe: numerics ok; measuring...\n")
     fns = {
-        "searched_bf16_staged": fenced(ours, jbufs),
-        "xla_single_jit": fenced(xla_fn, X, W1, W2, idx, w),
+        "searched_bf16_staged": ex.prepare_n(order),
+        "xla_single_jit": repeat_fenced(
+            lambda X_, W1_, W2_: xla_moe(X_, W1_, W2_, idx, w), X, W1, W2),
     }
     times, results = measure_set(fns)
     cost_staged = moe_cost(margs.tokens, margs.d_model, margs.d_ff, staged=True,

@@ -167,6 +167,12 @@ class EmpiricalBenchmarker:
                 n_samples * 2,
                 int(n_samples * 1.5 * opts.target_secs / max(wall, 1e-9)),
             )
+            if n_samples >= 1_000_000:
+                # the cap is reached and elapsed still misses the floor: the
+                # work is either folded away by the compiler or cheaper than
+                # the fence overhead at any n — accept the measurement rather
+                # than loop forever (the runs-test still judges the set)
+                return max(elapsed, 1e-12) / n_samples, n_samples
             n_samples = min(grow, 1_000_000)
 
     # reference benchmark(), benchmarker.cpp:121-167
@@ -247,13 +253,39 @@ class CallableRunner:
     measured with the SAME protocol as searched schedules, including the
     decorrelated paired batch: the "order" is just the callable's name.  Each
     callable must be fully fenced (end with a ``jax.device_get``), mirroring
-    the executor's fetch-fenced runners."""
+    the executor's fetch-fenced runners.
+
+    CAUTION: one fence per *sample* — through a high-RTT tunnel where the
+    per-call round trip rivals the calibrated fetch overhead, the adaptive
+    floor may never converge (elapsed-past-overhead stays ~0 while n_samples
+    doubles).  Fast kernels through a tunnel should use
+    :class:`RepeatCallableRunner` instead."""
 
     def __init__(self, fns: Dict[str, Callable[[], None]]):
         self.fns = dict(fns)
 
     def prepare(self, name: str) -> Callable[[], None]:
         return self.fns[name]
+
+
+class RepeatCallableRunner:
+    """ScheduleRunner over named ``run_n(n)`` callables: each invocation runs
+    n samples inside ONE fenced dispatch (the executor's ``prepare_n``
+    discipline), so a measurement costs one tunnel round trip regardless of
+    n and the adaptive floor converges for arbitrarily fast kernels.  The
+    callable must keep the n iterations live (loop-carried data dependence —
+    e.g. ``runtime.executor.datatie`` — or XLA hoists the loop-invariant
+    body and times one execution)."""
+
+    def __init__(self, run_ns: Dict[str, Callable[[int], None]]):
+        self.run_ns = dict(run_ns)
+
+    def prepare_n(self, name: str) -> Callable[[int], None]:
+        return self.run_ns[name]
+
+    def prepare(self, name: str) -> Callable[[], None]:
+        run_n = self.run_ns[name]
+        return lambda: run_n(1)
 
 
 class CachingBenchmarker:

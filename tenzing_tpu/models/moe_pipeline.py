@@ -19,6 +19,12 @@ Per microbatch chunk ``c`` the DAG is::
       -> combine_c (DeviceOp, lane-searched)  # weighted scatter-add
     all combine_c -> concat -> finish
 
+Round 3 adds the transfer-ENGINE dimension: each chunk chain's dispatch
+and combine hops can run as the host-staged round trip (spill+fetch, the
+non-GPU-aware-MPI staging analog) or as a device-resident remote-DMA copy
+(ops/rdma.py, the CUDA-aware analog) — ``engine="rdma"`` wires the latter,
+``staging="choice"`` searches the full precision x engine menu.
+
 The ``n_chunks`` chains are independent: the searched freedom is how chunk
 A's DMAs hide behind chunk B's expert compute and how the two DMA directions
 pipeline — the schedule MoE systems hand-tune.  The routing is host-side
@@ -222,23 +228,37 @@ class ConcatPipe(DeviceOp):
 
 
 def chunk_ops(args: MoEPipeArgs, c: int, cap: int, impl_choice: bool = False,
-              prec: str = "f32"):
-    """The 9-op chain for one microbatch chunk.  ``prec="bf16"`` routes the
+              prec: str = "f32", engine: str = "host"):
+    """The op chain for one microbatch chunk.  ``prec="bf16"`` routes the
     staged transfers through the half-width bfloat16 buffer set (op and
     buffer names carry a ``16`` suffix so both variants can coexist in one
-    choice graph)."""
+    choice graph); ``engine="rdma"`` replaces each host round trip with a
+    device-resident remote-DMA copy (ops/rdma.py — the CUDA-aware-MPI
+    analog; the host buffers stay declared but untouched)."""
     s = "16" if prec == "bf16" else ""
     mk = ExpertFFNPipeChoice if impl_choice else ExpertFFNPipe
     pack = DispatchPackPipe(f"pack{s}_{c}", c, args, cap, prec)
-    spilld = HostSpillStart(f"spilld{s}_{c}", f"send{s}_{c}", f"hdisp{s}_{c}")
-    fetchd = HostFetchStart(f"fetchd{s}_{c}", f"hdisp{s}_{c}", f"recv{s}_{c}")
+    if engine == "rdma":
+        from tenzing_tpu.ops.rdma import RdmaCopyStart
+
+        xfer_d = (RdmaCopyStart(f"xferd{s}_{c}.rdma", f"send{s}_{c}",
+                                f"recv{s}_{c}"),)
+        xfer_c = (RdmaCopyStart(f"xferc{s}_{c}.rdma", f"out{s}_{c}",
+                                f"ret{s}_{c}"),)
+    else:
+        xfer_d = (
+            HostSpillStart(f"spilld{s}_{c}", f"send{s}_{c}", f"hdisp{s}_{c}"),
+            HostFetchStart(f"fetchd{s}_{c}", f"hdisp{s}_{c}", f"recv{s}_{c}"),
+        )
+        xfer_c = (
+            HostSpillStart(f"spillc{s}_{c}", f"out{s}_{c}", f"hcomb{s}_{c}"),
+            HostFetchStart(f"fetchc{s}_{c}", f"hcomb{s}_{c}", f"ret{s}_{c}"),
+        )
     awaitd = AwaitTransfer(f"awaitd{s}_{c}", f"recv{s}_{c}")
     ffn = mk(f"ffn{s}_{c}", c, args, cap, prec)
-    spillc = HostSpillStart(f"spillc{s}_{c}", f"out{s}_{c}", f"hcomb{s}_{c}")
-    fetchc = HostFetchStart(f"fetchc{s}_{c}", f"hcomb{s}_{c}", f"ret{s}_{c}")
     awaitc = AwaitTransfer(f"awaitc{s}_{c}", f"ret{s}_{c}")
     comb = CombinePipe(f"combine{s}_{c}", c, args, cap, prec)
-    return pack, spilld, fetchd, awaitd, ffn, spillc, fetchc, awaitc, comb
+    return (pack,) + xfer_d + (awaitd, ffn) + xfer_c + (awaitc, comb)
 
 
 class ChunkChain(CompoundOp):
@@ -246,15 +266,16 @@ class ChunkChain(CompoundOp):
     fixed staging precision — the unit the staging ChoiceOp selects."""
 
     def __init__(self, c: int, args: MoEPipeArgs, cap: int,
-                 impl_choice: bool, prec: str):
-        super().__init__(f"chain_{c}.{prec}")
+                 impl_choice: bool, prec: str, engine: str = "host"):
+        super().__init__(f"chain_{c}.{prec}-{engine}")
         self._c, self._args, self._cap = c, args, cap
         self._impl_choice, self._prec = impl_choice, prec
+        self._engine = engine
 
     def graph(self) -> Graph:
         g = Graph()
         ops = chunk_ops(self._args, self._c, self._cap, self._impl_choice,
-                        self._prec)
+                        self._prec, self._engine)
         g.start_then(ops[0])
         for a, b in zip(ops, ops[1:]):
             g.then(a, b)
@@ -277,17 +298,19 @@ class StagingChoice(ChoiceOp):
 
     def choices(self) -> List[OpBase]:
         return [
-            ChunkChain(self._c, self._args, self._cap, self._impl_choice, "f32"),
-            ChunkChain(self._c, self._args, self._cap, self._impl_choice, "bf16"),
+            ChunkChain(self._c, self._args, self._cap, self._impl_choice,
+                       prec, engine)
+            for prec in ("f32", "bf16")
+            for engine in ("host", "rdma")
         ]
 
 
-PHASES = ("start", "pack", "spilld", "fetchd", "awaitd", "ffn", "spillc",
-          "fetchc", "awaitc", "combine", "concat", "finish")
+PHASES = ("start", "pack", "spilld", "fetchd", "xferd", "awaitd", "ffn",
+          "spillc", "fetchc", "xferc", "awaitc", "combine", "concat", "finish")
 
 
 def build_graph(args: MoEPipeArgs, cap: int, impl_choice: bool = False,
-                staging: str = "f32") -> Graph:
+                staging: str = "f32", engine: str = "host") -> Graph:
     """``n_chunks`` independent chains joined by the final concat (the
     multi-chip MoELayer's shape with the all-to-alls replaced by host round
     trips).  ``staging``: "f32" or "bf16" wires that variant directly;
@@ -302,7 +325,8 @@ def build_graph(args: MoEPipeArgs, cap: int, impl_choice: bool = False,
             g.start_then(chain)
             g.then(chain, cat)
             continue
-        ops = chunk_ops(args, c, cap, impl_choice, prec=staging)
+        ops = chunk_ops(args, c, cap, impl_choice, prec=staging,
+                        engine=engine)
         g.start_then(ops[0])
         for a, b in zip(ops, ops[1:]):
             g.then(a, b)
@@ -325,14 +349,16 @@ def naive_order(args: MoEPipeArgs, cap: int, platform) -> Sequence:
 
 
 def greedy_overlap_order(args: MoEPipeArgs, cap: int, platform,
-                         staging: str = "f32") -> Sequence:
+                         staging: str = "f32", engine: str = "host") -> Sequence:
     """Phase-ordered incumbent: all packs, all dispatch posts, ... — the
     software-pipelined discipline, via the shared greedy (solve/greedy.py).
-    ``staging="bf16"`` yields the half-width-transfer incumbent."""
+    ``staging="bf16"`` yields the half-width-transfer incumbent;
+    ``engine="rdma"`` the device-resident-transfer incumbent."""
     from tenzing_tpu.solve.greedy import greedy_phase_order
 
-    return greedy_phase_order(build_graph(args, cap, staging=staging),
-                              platform, PHASES)
+    return greedy_phase_order(
+        build_graph(args, cap, staging=staging, engine=engine),
+        platform, PHASES)
 
 
 def route_tokens(

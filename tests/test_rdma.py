@@ -164,3 +164,37 @@ def test_rdma_copy_start_serdes_roundtrip():
     assert [o.desc() for o in back.vector()] == [
         o.desc() for o in st.sequence.vector()
     ]
+
+
+def test_moe_pipeline_rdma_engine_correct():
+    """The MoE chunk chains' rdma staging variant produces the routed MoE
+    output (engine dimension of the staging menu, models/moe_pipeline.py)."""
+    from tenzing_tpu.models.moe_pipeline import (
+        MoEPipeArgs,
+        greedy_overlap_order,
+        host_buffer_names,
+        make_pipe_buffers,
+    )
+
+    margs = MoEPipeArgs(n_experts=4, tokens=32, d_model=8, d_ff=16, n_chunks=2)
+    bufs, want, cap = make_pipe_buffers(margs, seed=0)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names(margs))
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, jbufs)
+    order = greedy_overlap_order(margs, cap, plat, engine="rdma")
+    names = [op.desc() for op in order.vector()]
+    assert any(".rdma" in n for n in names)
+    assert not any(n.startswith("spilld") for n in names)
+    out = ex.run(order)
+    np.testing.assert_allclose(np.asarray(out["Y"]), want, rtol=2e-3, atol=2e-5)
+
+
+def test_moe_staging_choice_includes_engines():
+    """staging="choice" exposes the full prec x engine menu (4 variants)."""
+    from tenzing_tpu.models.moe_pipeline import MoEPipeArgs, build_graph
+    from tenzing_tpu.solve.dfs import structural_variants
+
+    margs = MoEPipeArgs(n_experts=2, tokens=8, d_model=4, d_ff=8, n_chunks=1)
+    g = build_graph(margs, cap=8, staging="choice")
+    variants = structural_variants(g)
+    assert len(variants) == 4

@@ -60,3 +60,33 @@ def test_callable_runner_measures_named_fns():
     assert len(times) == 2 and all(len(ts) == 3 for ts in times)
     res = emp.benchmark("a", BenchOpts(n_iters=3, target_secs=1e-4))
     assert res.pct50 > 0
+
+
+def test_repeat_callable_runner_one_fence_per_measurement():
+    import jax
+    from jax import lax
+
+    from tenzing_tpu.bench.benchmarker import RepeatCallableRunner
+
+    calls = []
+
+    def make_run_n():
+        from tenzing_tpu.runtime.executor import datatie
+
+        x = jnp.ones((64, 64))
+        # datatie keeps the body loop-carried so XLA cannot fold the loop
+        f = jax.jit(lambda n: lax.fori_loop(
+            0, n, lambda i, a: datatie(x, a).sum(), jnp.zeros(())))
+
+        def run_n(n):
+            calls.append(n)
+            jax.device_get(f(jnp.int32(n)))
+
+        return run_n
+
+    emp = EmpiricalBenchmarker(RepeatCallableRunner({"k": make_run_n()}))
+    res = emp.benchmark("k", BenchOpts(n_iters=3, target_secs=1e-4))
+    assert res.pct50 > 0
+    # the adaptive floor converges by growing n inside ONE dispatch, not by
+    # multiplying fenced calls: every recorded call is a single run_n(n)
+    assert len(calls) >= 4  # warmup + 3 iters (+ growth probes)
