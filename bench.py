@@ -335,6 +335,8 @@ def main() -> int:
                     ("greedy-host-8l", "host", 8),
                     ("greedy-rdma-2l", "rdma", 2),
                     ("greedy-rdma-3l", "rdma", 3),
+                    ("greedy-mixed-6l", "mixed", 6),
+                    ("greedy-mixed-8l", "mixed", 8),
                 ):
                     greedy_seqs.append((label, greedy_overlap_order(
                         built[3], Platform.make_n_lanes(nl), engine=engine)))

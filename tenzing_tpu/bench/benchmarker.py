@@ -170,9 +170,12 @@ class EmpiricalBenchmarker:
             if n_samples >= 1_000_000:
                 # the cap is reached and elapsed still misses the floor: the
                 # work is either folded away by the compiler or cheaper than
-                # the fence overhead at any n — accept the measurement rather
-                # than loop forever (the runs-test still judges the set)
-                return max(elapsed, 1e-12) / n_samples, n_samples
+                # the fence overhead at any n.  Return the RAW wall time per
+                # sample — an honest fence-dominated upper bound — rather
+                # than the overhead-subtracted residual, which can be ~0 or
+                # negative and would flow into paired ratios as a fabricated
+                # astronomic speedup
+                return wall / n_samples, n_samples
             n_samples = min(grow, 1_000_000)
 
     # reference benchmark(), benchmarker.cpp:121-167

@@ -179,6 +179,13 @@ def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = F
     else:
         pack = PackFlat(args, d)
         unpack = UnpackRecv(args, d)
+    if engine == "mixed":
+        # alternate engines across directions: the host path (PCIe + host
+        # memory) and the on-device DMA engine are DIFFERENT physical
+        # transfer resources, so a mixed assignment moves faces over both
+        # concurrently — a point the per-direction ChoiceOp space contains
+        # and this incumbent seeds directly
+        engine = "rdma" if DIRECTIONS.index(tuple(d)) % 2 == 0 else "host"
     if xfer_choice:
         xfer: Tuple = (TransferChoice(d),)
     elif engine == "rdma":
