@@ -15,10 +15,8 @@ Artifacts:
 * ``experiments/PROFILE_OVERLAP.json`` — the parsed concurrency summary.
 """
 
-import glob
 import json
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -52,87 +50,15 @@ def build(n=256):
 
 
 def capture(ex, name, order, iters=3):
-    import jax
+    from tenzing_tpu.utils.profiling import capture_trace
 
-    run_n = ex.prepare_n(order)
-    run_n(1)  # compile + warm
-    out_dir = TRACE_ROOT / name
-    out_dir.mkdir(parents=True, exist_ok=True)
-    t0 = time.perf_counter()
-    with jax.profiler.trace(str(out_dir)):
-        run_n(iters)
-    wall = time.perf_counter() - t0
-    return out_dir, wall
-
-
-def _events(plane):
-    for line in plane.lines:
-        lname = line.name
-        for ev in line.events:
-            yield lname, ev
+    return capture_trace(ex, order, TRACE_ROOT / name, iters=iters)
 
 
 def analyze(trace_dir: Path):
-    """Concurrency between transfer (DMA/copy) and compute events on the
-    device planes of the newest xplane file under ``trace_dir``."""
-    from jax.profiler import ProfileData
+    from tenzing_tpu.utils.profiling import analyze_trace
 
-    paths = sorted(glob.glob(str(trace_dir / "**" / "*.xplane.pb"),
-                             recursive=True))
-    if not paths:
-        return {"error": f"no xplane under {trace_dir}"}
-    data = ProfileData.from_file(paths[-1])
-    xfers, computes = [], []
-    for plane in data.planes:
-        pname = plane.name.lower()
-        if not ("tpu" in pname or "device" in pname or "xla" in pname):
-            continue
-        for lname, ev in _events(plane):
-            nm = (ev.name or "").lower()
-            iv = (ev.start_ns, ev.end_ns)
-            if iv[1] <= iv[0]:
-                continue
-            if any(k in nm for k in ("copy", "dma", "transfer", "infeed",
-                                     "outfeed", "send", "recv")):
-                xfers.append(iv)
-            # NOTE: no outer control events ("while"/"loop" span the whole
-            # program and would make every DMA look concurrent with compute)
-            elif any(k in nm for k in ("fusion", "dynamic", "slice", "pad",
-                                       "convert", "reshape", "add",
-                                       "concatenate")):
-                computes.append(iv)
-
-    def merge(ivs):
-        """Coalesce intervals so busy time and intersections count each
-        nanosecond once (overlapping events must not double-count)."""
-        out = []
-        for a, b in sorted(ivs):
-            if out and a <= out[-1][1]:
-                out[-1][1] = max(out[-1][1], b)
-            else:
-                out.append([a, b])
-        return out
-
-    def total(ivs):
-        return sum(b - a for a, b in merge(ivs))
-
-    overlap_ns = 0
-    computes_merged = merge(computes)
-    for a, b in merge(xfers):
-        for c, d in computes_merged:
-            if c >= b:
-                break
-            lo, hi = max(a, c), min(b, d)
-            if hi > lo:
-                overlap_ns += hi - lo
-    return {
-        "xplane": paths[-1],
-        "n_transfer_events": len(xfers),
-        "n_compute_events": len(computes),
-        "transfer_busy_ms": total(xfers) / 1e6,
-        "compute_busy_ms": total(computes) / 1e6,
-        "transfer_concurrent_with_compute_ms": overlap_ns / 1e6,
-    }
+    return analyze_trace(trace_dir)
 
 
 def main() -> int:

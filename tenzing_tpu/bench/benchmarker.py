@@ -174,8 +174,9 @@ class EmpiricalBenchmarker:
                 # sample — an honest fence-dominated upper bound — rather
                 # than the overhead-subtracted residual, which can be ~0 or
                 # negative and would flow into paired ratios as a fabricated
-                # astronomic speedup
-                return wall / n_samples, n_samples
+                # astronomic speedup.  Max-reduced across hosts like every
+                # other return from _measure (the benchmark() invariant).
+                return self.cp.allreduce_max(wall) / n_samples, n_samples
             n_samples = min(grow, 1_000_000)
 
     # reference benchmark(), benchmarker.cpp:121-167

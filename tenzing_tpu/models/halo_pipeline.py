@@ -167,9 +167,13 @@ def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = F
     """The op chain for one face direction: (pack, transfer ops, await,
     unpack).  ``impl_choice`` turns pack/unpack into the kernel menu;
     ``xfer_choice`` turns the transfer into the engine menu; ``engine``
-    ("host" | "rdma") wires one engine directly when no menu is wanted (the
-    heuristic incumbents pick an engine up front — greedy_phase_order makes
-    no ChooseOp decisions)."""
+    ("host" | "rdma" | "mixed") wires one engine directly when no menu is
+    wanted (the heuristic incumbents pick an engine up front —
+    greedy_phase_order makes no ChooseOp decisions); "mixed" alternates
+    engines across directions so both physical transfer paths run
+    concurrently (the flagship 1.337x incumbent)."""
+    if engine not in ("host", "rdma", "mixed"):
+        raise ValueError(f"unknown transfer engine {engine!r}")
     name = dir_name(d)
     if impl_choice:
         from tenzing_tpu.ops.halo_pallas import PackChoice, UnpackChoice

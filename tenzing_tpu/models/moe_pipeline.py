@@ -235,6 +235,8 @@ def chunk_ops(args: MoEPipeArgs, c: int, cap: int, impl_choice: bool = False,
     choice graph); ``engine="rdma"`` replaces each host round trip with a
     device-resident remote-DMA copy (ops/rdma.py — the CUDA-aware-MPI
     analog; the host buffers stay declared but untouched)."""
+    if engine not in ("host", "rdma"):
+        raise ValueError(f"unknown transfer engine {engine!r}")
     s = "16" if prec == "bf16" else ""
     mk = ExpertFFNPipeChoice if impl_choice else ExpertFFNPipe
     pack = DispatchPackPipe(f"pack{s}_{c}", c, args, cap, prec)

@@ -1,0 +1,54 @@
+"""Device-side profiling component (SURVEY §5 tracing): trace capture via the
+executor + programmatic overlap analysis.  On CPU the xplane has no device
+planes, so the concurrency numbers are zero — the capture/parse machinery and
+the interval algebra are what these tests pin; the on-TPU evidence lives in
+experiments/PROFILE_OVERLAP.json."""
+
+import numpy as np
+
+from tenzing_tpu.utils.profiling import analyze_trace, capture_trace, merge_intervals
+
+
+def test_merge_intervals_coalesces_and_counts_once():
+    ivs = [(0, 10), (5, 15), (20, 30), (30, 40), (50, 60)]
+    merged = merge_intervals(ivs)
+    assert merged == [[0, 15], [20, 40], [50, 60]]
+    assert sum(b - a for a, b in merged) == 45
+
+
+def test_capture_trace_produces_parseable_xplane(tmp_path):
+    import jax.numpy as jnp
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.operation import DeviceOp
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import State
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    class Mul(DeviceOp):
+        def reads(self):
+            return ["x"]
+
+        def writes(self):
+            return ["y"]
+
+        def apply(self, bufs, ctx):
+            return {"y": bufs["x"] * 2.0}
+
+    g = Graph()
+    m = Mul("m")
+    g.start_then(m)
+    g.then_finish(m)
+    plat = Platform.make_n_lanes(1)
+    ex = TraceExecutor(plat, {"x": jnp.ones((8, 8)), "y": jnp.zeros((8, 8))})
+    st = State(g)
+    while not st.is_terminal():
+        st = st.apply(st.get_decisions(plat)[0])
+    tdir, wall = capture_trace(ex, st.sequence, tmp_path / "t", iters=2)
+    assert wall > 0
+    summary = analyze_trace(tdir)
+    # CPU traces may expose no device planes; the parse must still succeed
+    # and return the full key set (or a clear error about a missing xplane)
+    if "error" not in summary:
+        assert {"transfer_busy_ms", "compute_busy_ms",
+                "transfer_concurrent_with_compute_ms"} <= set(summary)

@@ -209,3 +209,47 @@ def test_postprocess_on_real_recorded_data():
     assert out["n"] == 13
     assert len(out["classes"]) == 13
     assert max(out["classes"]) >= 0
+
+
+# -- round-3 recorded databases (transfer-engine menu in the space) ----------
+
+R3C_PATH = os.path.join(REPO, "experiments", "halo_search_tpu_r3c.csv")
+ATTN_R3_PATH = os.path.join(REPO, "experiments", "attn_search_tpu_r3.csv")
+
+
+@pytest.fixture(scope="module")
+def db_r3c():
+    """The 1.337x flagship database: rows mix host-staged, RDMA and
+    mixed-engine schedules over the full kernel x engine choice graph."""
+    g = build_graph(ARGS, impl_choice=True, xfer_choice=True)
+    return CsvBenchmarker.from_file(R3C_PATH, g, strict=False)
+
+
+def test_r3_flagship_rows_deserialize_and_answer(db_r3c):
+    # the searched rows anchor against the menus (incl. RdmaCopyStart inside
+    # TransferChoice and spill/fetch inside the HostRoundTrip compound); the
+    # naive row was recorded from the engine-free graph and may be skipped
+    assert len(db_r3c.entries) >= 90
+    engines = set()
+    for seq, res in db_r3c.entries:
+        assert res.pct50 > 0
+        names = [op.desc() for op in seq.vector()]
+        engines.add("rdma" if any(".rdma" in n for n in names) else "host")
+        assert db_r3c.benchmark(seq).pct50 == res.pct50
+    assert engines == {"rdma", "host"}  # both engines present in the record
+
+
+def test_r3_attn_rows_deserialize_and_answer():
+    import jax.numpy as jnp  # noqa: F401
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.models.ring_attention import BlockedAttention, RingAttnArgs
+
+    aargs = RingAttnArgs(n_devices=8, batch=4, seq_local=1024, head_dim=128)
+    g = Graph()
+    g.start_then(BlockedAttention(aargs, impl_choice=True))
+    g.then_finish(BlockedAttention(aargs, impl_choice=True))
+    db = CsvBenchmarker.from_file(ATTN_R3_PATH, g, strict=False)
+    assert len(db.entries) >= 90
+    for seq, res in list(db.entries)[:10]:
+        assert db.benchmark(seq).pct50 == res.pct50
