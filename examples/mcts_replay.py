@@ -29,6 +29,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     _driver.add_common_args(ap)
     ap.add_argument("--csv", required=True, help="recorded result database")
+    ap.add_argument("--workload", choices=("spmv", "halo"), default="spmv",
+                    help="the graph the database rows anchor against")
     ap.add_argument("--mcts-iters", type=int, default=64)
     ap.add_argument("--strategies", default="Random,FastMin,Coverage,AntiCorrelation",
                     help="comma-separated strategy names to compare")
@@ -38,16 +40,41 @@ def main() -> int:
     from tenzing_tpu.bench.benchmarker import BenchOpts, CsvBenchmarker
     from tenzing_tpu.core.graph import Graph
     from tenzing_tpu.core.platform import Platform
-    from tenzing_tpu.models.spmv import SpMVCompound
     from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
 
-    g = Graph()
-    g.start_then(SpMVCompound())
-    g.then_finish(SpMVCompound())
-    db = CsvBenchmarker.from_file(args.csv, g, normalize=True)
-    recorded_best = min(r.pct50 for _, r in db.entries)
+    if args.workload == "halo":
+        # the round-3 flagship space: kernel menu x transfer-engine menu
+        # (halo_search_tpu_r3*.csv record searches over this graph)
+        from tenzing_tpu.models.halo import HaloArgs
+        from tenzing_tpu.models.halo_pipeline import build_graph
+
+        g = build_graph(HaloArgs(nq=3, lx=512, ly=512, lz=512, radius=3),
+                        impl_choice=True, xfer_choice=True)
+    else:
+        from tenzing_tpu.models.spmv import SpMVCompound
+
+        g = Graph()
+        g.start_then(SpMVCompound())
+        g.then_finish(SpMVCompound())
+    db = CsvBenchmarker.from_file(args.csv, g, normalize=True, strict=False)
+    if not db.entries:
+        raise SystemExit(
+            f"no row of {args.csv} deserializes against the "
+            f"--workload {args.workload} graph ({len(db.skipped)} skipped) — "
+            "workload/CSV mismatch?"
+        )
+    # the optimum comes from the RAW pct50 column of every recorded row:
+    # rows recorded from a different graph shape (e.g. pre-choice incumbent
+    # schedules) may not deserialize for replay matching, but their TIMES are
+    # still the database's ground truth — the iterations-to-optimum signal
+    # must not silently improve because the best row was unmatchable
+    with open(args.csv) as f:
+        recorded_best = min(
+            float(line.split("|")[3]) for line in f if line.strip()
+        )
+    skipped = f", {len(db.skipped)} rows unmatchable for replay" if db.skipped else ""
     sys.stderr.write(
-        f"database: {len(db.entries)} schedules, best pct50 "
+        f"database: {len(db.entries)} schedules{skipped}, best pct50 "
         f"{recorded_best*1e6:.1f}us\n"
     )
 
