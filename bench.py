@@ -203,6 +203,8 @@ def main() -> int:
     ap.add_argument("--iters", type=int, default=20, help="measurements per schedule (screen/final)")
     ap.add_argument("--search-iters", type=int, default=6,
                     help="measurements per schedule during MCTS (cheap phase)")
+    ap.add_argument("--climb-budget", type=int, default=24,
+                    help="hill-climb benchmark budget after MCTS (halo)")
     ap.add_argument("--dump-csv", default=None, help="write searched results as CSV rows")
     args = ap.parse_args()
 
@@ -396,12 +398,46 @@ def main() -> int:
     )
     res.sims = incumbents + res.sims
 
+    if args.workload == "halo" and not args.smoke and args.climb_budget > 0:
+        # neighborhood search from the mixed-engine incumbent: hill-climb in
+        # decision space (solve/local.py) refines the best heuristic with
+        # measured single-substitution moves — the local complement to
+        # MCTS's global exploration, at the same cheap search cost
+        from tenzing_tpu.models.halo import DIRECTIONS, dir_name
+        from tenzing_tpu.models.halo_pipeline import HALO_PHASES as halo_phases
+        from tenzing_tpu.solve.local import LocalOpts, hill_climb
+
+        dirs = [dir_name(d) for d in DIRECTIONS]
+
+        def mixed_prefer(op_name, choices):
+            if op_name.startswith("xfer_"):
+                i = dirs.index(op_name.split("_", 1)[1])
+                want = ".rdma" if i % 2 == 0 else ".host"
+                return next((c for c in choices if c.endswith(want)), None)
+            return next((c for c in choices if c.endswith(".xla")), None)
+
+        t0 = time.time()
+        lres = hill_climb(
+            g, plat, bench, halo_phases, prefer=mixed_prefer,
+            opts=LocalOpts(budget=args.climb_budget, bench_opts=search_opts,
+                           seed=2),
+        )
+        lbest = lres.best()
+        sys.stderr.write(
+            f"hill-climb: {len(lres.sims)} candidates, best "
+            f"pct50={lbest.result.pct50*1e6:.1f}us (wall {time.time()-t0:.0f}s)\n"
+        )
+        for s in lres.sims:
+            incumbent_labels[id(s)] = "climb"
+        res.sims = res.sims + lres.sims
+
     # Candidate selection is DRIFT-IMMUNE (VERDICT r2 weak #1: raw search-
     # phase pct50s picked final candidates while naive drifted 254ms -> 129ms
     # within one run, and 2 of 4 finalists lost to naive).  Two paired
     # decorrelated batches (reference batch benchmark, benchmarker.cpp:21-76):
     #
-    #   screen: naive + up to 8 distinct candidates, moderate cost; paired
+    #   screen: naive + the distinct candidates (incumbent grid + top
+    #           searched), moderate cost; paired
     #           per-iteration speedups rank them, dropping everything whose
     #           paired median is < 1.0 — search-time drift cancels because
     #           iteration k visits every schedule back-to-back;
@@ -430,10 +466,11 @@ def main() -> int:
         return "rdma" if any(".rdma" in n for n in names) else "host"
 
     def label_of(s) -> str:
-        """'greedy-host-8l' for a labeled incumbent, 'mcts/<engine>' for a
-        searched rollout — the screen/final printouts must distinguish the
-        incumbent-grid entries they exist to compare."""
-        return incumbent_labels.get(id(s), f"mcts/{engine_of(s.order)}")
+        """'greedy-host-8l' for a labeled incumbent, 'climb/<engine>' for a
+        hill-climb candidate, 'mcts/<engine>' for an MCTS rollout — the
+        screen/final printouts must distinguish the entries they compare."""
+        base = incumbent_labels.get(id(s), "mcts")
+        return f"{base}/{engine_of(s.order)}" if base in ("mcts", "climb") else base
 
     # distinct candidates by canonical key; heuristic incumbents always
     # advance to screening (search-time noise must not knock them out)
@@ -448,7 +485,10 @@ def main() -> int:
         if key not in seen:
             seen.add(key)
             cands.append(s)
-    cands = cands[: 8 if not args.smoke else 4]
+    # the screen needs room for searched candidates BEYOND the incumbent
+    # grid (7 labeled incumbents for halo) without shrinking the pool for
+    # workloads with few incumbents
+    cands = cands[: max(8, len(incumbents) + 4) if not args.smoke else 4]
 
     vs = 1.0
     value_us = naive.pct50 * 1e6

@@ -68,9 +68,15 @@ def main() -> int:
     # schedules) may not deserialize for replay matching, but their TIMES are
     # still the database's ground truth — the iterations-to-optimum signal
     # must not silently improve because the best row was unmatchable
+    def row_pct50(line):
+        parts = line.split("|")
+        try:
+            return float(parts[3])
+        except (IndexError, ValueError):  # truncated/malformed row: skip,
+            return float("inf")           # like the strict=False loader
     with open(args.csv) as f:
         recorded_best = min(
-            float(line.split("|")[3]) for line in f if line.strip()
+            (row_pct50(line) for line in f if line.strip()), default=float("inf")
         )
     skipped = f", {len(db.skipped)} rows unmatchable for replay" if db.skipped else ""
     sys.stderr.write(

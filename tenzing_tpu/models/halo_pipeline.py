@@ -236,6 +236,12 @@ def build_graph(args: HaloArgs, impl_choice: bool = False,
                         xfer_choice=xfer_choice, engine=engine)
 
 
+# phase order of the pipeline's op-name prefixes (greedy incumbents and the
+# hill-climb policy share it; covers both transfer engines)
+HALO_PHASES = ("start", "pack", "spill", "fetch", "xfer", "await", "unpack",
+               "finish")
+
+
 def naive_order(args: HaloArgs, platform) -> Sequence:
     """The naive sequential baseline: one lane, each direction's chain completed
     (post immediately awaited) before the next starts — the fully-synchronous
@@ -259,11 +265,8 @@ def greedy_overlap_order(args: HaloArgs, platform, engine: str = "host") -> Sequ
     before any await, unpacks last (solve/greedy.py)."""
     from tenzing_tpu.solve.greedy import greedy_phase_order
 
-    return greedy_phase_order(
-        build_graph(args, engine=engine),
-        platform,
-        ("start", "pack", "spill", "fetch", "xfer", "await", "unpack", "finish"),
-    )
+    return greedy_phase_order(build_graph(args, engine=engine), platform,
+                              HALO_PHASES)
 
 
 def _padded_shape(shape: Tuple[int, int, int, int]) -> Tuple[int, int, int, int]:

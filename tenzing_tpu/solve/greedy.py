@@ -28,57 +28,10 @@ def greedy_phase_order(graph: Graph, platform, phases: Seq[str]) -> Sequence:
     ``platform.lanes``; a later-phase op never runs while an earlier-phase op
     anywhere in the graph is unexecuted (the required sync is placed
     instead), so every phase-``k`` op happens before any phase-``k+1`` op on
-    *all* lanes."""
-    from tenzing_tpu.core.state import AssignLane, ExecuteOp, State
-    from tenzing_tpu.core.sync_ops import SyncOp
+    *all* lanes.  One implementation of the discipline: this is
+    ``solve.local.drive`` under ``solve.local.phase_policy`` (which also
+    resolves ChoiceOps and expands compounds for choice graphs)."""
+    from tenzing_tpu.solve.local import drive, phase_policy
 
-    def phase(op) -> int:
-        name = op.name()
-        for i, p in enumerate(phases):
-            if name.startswith(p):
-                return i
-        return 0  # sync ops: only reachable via the fallback branch below
-
-    st = State(graph)
-    lane_rr = 0
-    while not st.is_terminal():
-        ds = st.get_decisions(platform)
-        assigns = sorted(
-            (d for d in ds if isinstance(d, AssignLane)), key=lambda d: d.op.name()
-        )
-        if assigns:
-            # round-robin the alphabetically-first unassigned op onto lanes
-            opname = assigns[0].op.name()
-            lane = platform.lanes[lane_rr % len(platform.lanes)]
-            lane_rr += 1
-            # fall back to any offered AssignLane for the op if the round-robin
-            # lane is not among the offered decisions (a platform may expose an
-            # op on a lane subset; ADVICE r2)
-            d = next(
-                (d for d in assigns if d.op.name() == opname and d.lane == lane),
-                assigns[0],
-            )
-            st = st.apply(d)
-            continue
-        execs = [d for d in ds if isinstance(d, ExecuteOp)]
-        real = sorted(
-            (d for d in execs if not isinstance(d.op, SyncOp)),
-            key=lambda d: (phase(d.op), d.op.name()),
-        )
-        syncs = sorted(
-            (d for d in execs if isinstance(d.op, SyncOp)), key=lambda d: d.op.desc()
-        )
-        # never run a later-phase op while an earlier-phase op anywhere in the
-        # graph is still unexecuted (it is gated behind one of the offered
-        # syncs): place the sync instead, keeping every phase-k op ahead of
-        # every phase-k+1 op across *all* lanes
-        done = {op.name() for op in st.sequence}
-        pending_min = min(
-            (phase(v) for v in st.graph.vertices() if v.name() not in done),
-            default=99,
-        )
-        if real and (not syncs or phase(real[0].op) <= pending_min):
-            st = st.apply(real[0])
-            continue
-        st = st.apply(syncs[0])
-    return st.sequence
+    seq, _ = drive(graph, platform, phase_policy(platform, phases))
+    return seq

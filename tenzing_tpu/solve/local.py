@@ -1,0 +1,218 @@
+"""Neighborhood search over schedules: hill-climbing in decision space.
+
+Beyond the reference's two solvers (exhaustive DFS, MCTS): the measured
+anytime driver showed hand-built greedy incumbents repeatedly winning the
+paired final while MCTS rollouts — exploring the full space from scratch —
+lagged.  This solver searches the *neighborhood of an incumbent* instead: a
+schedule is represented by the decision list that builds it from
+``State(graph)``; a neighbor substitutes ONE decision (a different lane
+binding, implementation choice, or execution order pick) and completes the
+rest by following the original plan where it still applies, falling back to
+the phase policy where it does not.  First-improvement hill climbing under a
+benchmark budget then refines the incumbent with measured steps — the classic
+local-search complement to MCTS's global exploration, sharing the same SDP
+machinery, benchmarkers, and caching as the other solvers.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence as Seq, Tuple
+
+from tenzing_tpu.bench.benchmarker import BenchOpts
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.state import (
+    AssignLane,
+    ChooseOp,
+    Decision,
+    ExecuteOp,
+    ExpandOp,
+    State,
+)
+
+
+def phase_policy(platform, phases: Seq[str],
+                 prefer: Optional[Callable[[str, List[str]], Optional[str]]] = None):
+    """A policy closure for :func:`drive`: expand compounds eagerly, resolve
+    ChoiceOps via ``prefer(choice_op_name, choice_names) -> chosen name`` (or
+    the first choice), round-robin lane bindings, and execute in ``phases``
+    order with the sync-gating discipline of solve/greedy.py."""
+    from tenzing_tpu.core.sync_ops import SyncOp
+
+    lane_rr = [0]
+
+    def phase(op) -> int:
+        name = op.name()
+        for i, p in enumerate(phases):
+            if name.startswith(p):
+                return i
+        return 0
+
+    def policy(st: State, ds: List[Decision]) -> Decision:
+        expands = [d for d in ds if isinstance(d, ExpandOp)]
+        if expands:
+            return expands[0]
+        chooses = [d for d in ds if isinstance(d, ChooseOp)]
+        if chooses:
+            grp = sorted(
+                (d for d in chooses if d.op.name() == chooses[0].op.name()),
+                key=lambda d: d.choice.name(),
+            )
+            if prefer is not None:
+                want = prefer(grp[0].op.name(), [d.choice.name() for d in grp])
+                pick = next((d for d in grp if d.choice.name() == want), None)
+                if pick is not None:
+                    return pick
+            return grp[0]
+        assigns = sorted(
+            (d for d in ds if isinstance(d, AssignLane)), key=lambda d: d.op.name()
+        )
+        if assigns:
+            opname = assigns[0].op.name()
+            lane = platform.lanes[lane_rr[0] % len(platform.lanes)]
+            lane_rr[0] += 1
+            return next(
+                (d for d in assigns if d.op.name() == opname and d.lane == lane),
+                assigns[0],
+            )
+        execs = [d for d in ds if isinstance(d, ExecuteOp)]
+        real = sorted(
+            (d for d in execs if not isinstance(d.op, SyncOp)),
+            key=lambda d: (phase(d.op), d.op.name()),
+        )
+        syncs = sorted(
+            (d for d in execs if isinstance(d.op, SyncOp)), key=lambda d: d.op.desc()
+        )
+        done = {op.name() for op in st.sequence}
+        pending_min = min(
+            (phase(v) for v in st.graph.vertices() if v.name() not in done),
+            default=99,
+        )
+        if real and (not syncs or phase(real[0].op) <= pending_min):
+            return real[0]
+        return syncs[0]
+
+    return policy
+
+
+def drive(graph: Graph, platform, policy) -> Tuple[Sequence, List[Decision]]:
+    """Run ``policy`` to a terminal state, recording the decision list."""
+    st = State(graph)
+    decisions: List[Decision] = []
+    while not st.is_terminal():
+        ds = st.get_decisions(platform)
+        d = policy(st, ds)
+        decisions.append(d)
+        st = st.apply(d)
+    return st.sequence, decisions
+
+
+def replay_with_substitution(
+    graph: Graph, platform, decisions: List[Decision], i: int,
+    alt: Decision, fallback,
+) -> Tuple[Sequence, List[Decision]]:
+    """The neighbor: apply ``decisions[:i]``, then ``alt`` instead of
+    ``decisions[i]``, then complete by taking any still-offered decision from
+    the original plan (earliest-planned first) and falling back to
+    ``fallback`` when the plan no longer applies (e.g. after an
+    implementation-choice flip invalidated downstream ops)."""
+    st = State(graph)
+    taken: List[Decision] = []
+    for d in decisions[:i]:
+        st = st.apply(d)
+        taken.append(d)
+    st = st.apply(alt)
+    taken.append(alt)
+    plan = list(decisions[i + 1:])
+    while not st.is_terminal():
+        ds = st.get_decisions(platform)
+        offered = {d.key(): d for d in ds}
+        pick = None
+        for j, p in enumerate(plan):
+            got = offered.get(p.key())
+            if got is not None:
+                pick = got
+                del plan[j]
+                break
+        if pick is None:
+            pick = fallback(st, ds)
+        st = st.apply(pick)
+        taken.append(pick)
+    return st.sequence, taken
+
+
+@dataclass
+class LocalOpts:
+    """``budget`` counts benchmarked candidates (the expensive unit); a
+    CachingBenchmarker makes revisits free."""
+
+    budget: int = 24
+    bench_opts: BenchOpts = field(default_factory=BenchOpts)
+    seed: int = 0
+    max_alts_per_step: int = 3
+
+
+@dataclass
+class LocalResult:
+    sims: List = field(default_factory=list)  # SimResult-compatible entries
+
+    def best(self):
+        return min(self.sims, key=lambda s: s.result.pct50) if self.sims else None
+
+
+def hill_climb(
+    graph: Graph, platform, benchmarker, phases: Seq[str],
+    prefer=None, opts: Optional[LocalOpts] = None,
+) -> LocalResult:
+    """First-improvement hill climbing from the phase-policy incumbent."""
+    from tenzing_tpu.solve.mcts.mcts import SimResult
+
+    opts = opts if opts is not None else LocalOpts()
+    rng = _random.Random(opts.seed)
+    fallback = phase_policy(platform, phases, prefer)
+    seq, decisions = drive(graph, platform, fallback)
+    result = LocalResult()
+    cur = benchmarker.benchmark(seq, opts.bench_opts)
+    result.sims.append(SimResult(order=seq, result=cur))
+    spent = 1
+
+    def sweep_order(decs):
+        """Shuffled positions, structural decisions (implementation choices,
+        lane bindings) first — they are sparse in the list but carry the
+        biggest schedule differences."""
+        struct = [i for i, d in enumerate(decs)
+                  if isinstance(d, (ChooseOp, AssignLane))]
+        rest = [i for i in range(len(decs)) if i not in set(struct)]
+        rng.shuffle(struct)
+        rng.shuffle(rest)
+        return struct + rest
+
+    improved = True
+    while spent < opts.budget and improved:
+        improved = False
+        for i in sweep_order(decisions):
+            # re-derive the state at position i to enumerate alternatives
+            st = State(graph)
+            for d in decisions[:i]:
+                st = st.apply(d)
+            ds = st.get_decisions(platform)
+            alts = [d for d in ds if d.key() != decisions[i].key()]
+            rng.shuffle(alts)
+            for alt in alts[: opts.max_alts_per_step]:
+                cand_seq, cand_dec = replay_with_substitution(
+                    graph, platform, decisions, i, alt, fallback
+                )
+                res = benchmarker.benchmark(cand_seq, opts.bench_opts)
+                result.sims.append(SimResult(order=cand_seq, result=res))
+                spent += 1
+                if res.pct50 < cur.pct50:  # first improvement: move
+                    cur, seq, decisions = res, cand_seq, cand_dec
+                    improved = True
+                    break
+                if spent >= opts.budget:
+                    break
+            if improved or spent >= opts.budget:
+                break
+    return result

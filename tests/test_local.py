@@ -1,0 +1,93 @@
+"""Neighborhood search (solve/local.py): policy drive, one-substitution
+replay, and hill climbing against a deterministic fake benchmarker."""
+
+import numpy as np
+import pytest
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, CachingBenchmarker
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.core.state import ChooseOp, State
+from tenzing_tpu.models.halo import HaloArgs
+from tenzing_tpu.models.halo_pipeline import HALO_PHASES, build_graph
+from tenzing_tpu.solve.local import (
+    LocalOpts,
+    drive,
+    hill_climb,
+    phase_policy,
+    replay_with_substitution,
+)
+
+PHASES = HALO_PHASES
+ARGS = HaloArgs(nq=1, lx=2, ly=2, lz=2, radius=1)
+
+
+def mk(prefer=None, lanes=2):
+    g = build_graph(ARGS, xfer_choice=True)
+    plat = Platform.make_n_lanes(lanes)
+    return g, plat, phase_policy(plat, PHASES, prefer)
+
+
+def test_drive_resolves_choice_graph_to_terminal():
+    g, plat, pol = mk()
+    seq, decisions = drive(g, plat, pol)
+    names = [op.desc() for op in seq.vector()]
+    assert names[0] == "start" and names[-1] == "finish"
+    # default preference takes the first (host) choice everywhere
+    assert any(n.startswith("spill_") for n in names)
+    assert len(decisions) > len(names) - 2  # choices/expands/assigns on top
+
+
+def test_prefer_callback_selects_engines():
+    prefer = lambda op, choices: next(c for c in choices if c.endswith(".rdma"))
+    g, plat, pol = mk(prefer)
+    seq, _ = drive(g, plat, pol)
+    names = [op.desc() for op in seq.vector()]
+    assert any(".rdma" in n for n in names)
+    assert not any(n.startswith("spill_") for n in names)
+
+
+def test_replay_with_substitution_flips_one_choice():
+    g, plat, pol = mk()
+    seq, decisions = drive(g, plat, pol)
+    # find the first ChooseOp decision and substitute the other engine
+    i = next(j for j, d in enumerate(decisions) if isinstance(d, ChooseOp))
+    st = State(g)
+    for d in decisions[:i]:
+        st = st.apply(d)
+    alts = [d for d in st.get_decisions(plat)
+            if isinstance(d, ChooseOp) and d.op.name() == decisions[i].op.name()
+            and d.key() != decisions[i].key()]
+    assert alts
+    seq2, dec2 = replay_with_substitution(g, plat, decisions, i, alts[0], pol)
+    names2 = [op.desc() for op in seq2.vector()]
+    assert names2[-1] == "finish"
+    # exactly one direction's transfer now uses the other engine
+    assert sum(1 for n in names2 if ".rdma" in n) == 1
+
+
+class RiggedBenchmarker:
+    """Deterministic: schedules using more rdma transfers are faster."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def benchmark(self, order, opts=None):
+        self.calls += 1
+        n_rdma = sum(1 for op in order.vector() if ".rdma" in op.desc())
+        t = 1.0 - 0.1 * n_rdma
+        return BenchResult(pct01=t, pct10=t, pct50=t, pct90=t, pct99=t, stddev=0.0)
+
+
+def test_hill_climb_discovers_the_rigged_optimum_direction():
+    g, plat, _ = mk()
+    bench = CachingBenchmarker(RiggedBenchmarker())
+    res = hill_climb(
+        g, plat, bench, PHASES,
+        opts=LocalOpts(budget=40, bench_opts=BenchOpts(n_iters=1), seed=3),
+    )
+    best = res.best()
+    assert best is not None
+    start = res.sims[0].result.pct50  # the all-host incumbent
+    assert best.result.pct50 < start  # climbed toward rdma flips
+    assert any(".rdma" in op.desc() for op in best.order.vector())
