@@ -398,27 +398,43 @@ def main() -> int:
     )
     res.sims = incumbents + res.sims
 
-    if args.workload == "halo" and not args.smoke and args.climb_budget > 0:
-        # neighborhood search from the mixed-engine incumbent: hill-climb in
-        # decision space (solve/local.py) refines the best heuristic with
-        # measured single-substitution moves — the local complement to
-        # MCTS's global exploration, at the same cheap search cost
+    # neighborhood search from the best-known heuristic: hill-climb in
+    # decision space (solve/local.py) refines it with measured
+    # single-substitution moves — the local complement to MCTS's global
+    # exploration, at the same cheap search cost
+    climb_cfg = None
+    if args.workload == "halo" and not args.smoke:
         from tenzing_tpu.models.halo import DIRECTIONS, dir_name
-        from tenzing_tpu.models.halo_pipeline import HALO_PHASES as halo_phases
-        from tenzing_tpu.solve.local import LocalOpts, hill_climb
+        from tenzing_tpu.models.halo_pipeline import HALO_PHASES
 
         dirs = [dir_name(d) for d in DIRECTIONS]
 
-        def mixed_prefer(op_name, choices):
+        def halo_prefer(op_name, choices):
             if op_name.startswith("xfer_"):
                 i = dirs.index(op_name.split("_", 1)[1])
                 want = ".rdma" if i % 2 == 0 else ".host"
                 return next((c for c in choices if c.endswith(want)), None)
             return next((c for c in choices if c.endswith(".xla")), None)
 
+        climb_cfg = (HALO_PHASES, halo_prefer)
+    elif args.workload == "moe" and not args.smoke:
+        from tenzing_tpu.models.moe_pipeline import PHASES as MOE_PHASES
+
+        def moe_prefer(op_name, choices):
+            # whole-chain staging choice: device-resident bf16 transfers (the
+            # measured 10.97x winner); kernel choices default to XLA
+            return next(
+                (c for c in choices if c.endswith(".bf16-rdma")),
+                next((c for c in choices if c.endswith(".xla")), None),
+            )
+
+        climb_cfg = (MOE_PHASES, moe_prefer)
+    if climb_cfg is not None and args.climb_budget > 0:
+        from tenzing_tpu.solve.local import LocalOpts, hill_climb
+
         t0 = time.time()
         lres = hill_climb(
-            g, plat, bench, halo_phases, prefer=mixed_prefer,
+            g, plat, bench, climb_cfg[0], prefer=climb_cfg[1],
             opts=LocalOpts(budget=args.climb_budget, bench_opts=search_opts,
                            seed=2),
         )

@@ -145,8 +145,9 @@ def replay_with_substitution(
 
 @dataclass
 class LocalOpts:
-    """``budget`` counts benchmarked candidates (the expensive unit); a
-    CachingBenchmarker makes revisits free."""
+    """``budget`` counts benchmarked DISTINCT schedules: canonical-key
+    dedup skips no-op neighbors (a substitution that rebuilds the identical
+    schedule) without charging the budget."""
 
     budget: int = 24
     bench_opts: BenchOpts = field(default_factory=BenchOpts)
@@ -169,13 +170,20 @@ def hill_climb(
     """First-improvement hill climbing from the phase-policy incumbent."""
     from tenzing_tpu.solve.mcts.mcts import SimResult
 
+    from tenzing_tpu.core.sequence import canonical_key
+
     opts = opts if opts is not None else LocalOpts()
     rng = _random.Random(opts.seed)
-    fallback = phase_policy(platform, phases, prefer)
-    seq, decisions = drive(graph, platform, fallback)
+    # a FRESH policy per drive/replay: phase_policy carries a round-robin
+    # lane counter, and sharing one closure would make the schedule a given
+    # (position, alternative) neighbor maps to depend on how many fallback
+    # assignments happened earlier in the run
+    fresh = lambda: phase_policy(platform, phases, prefer)
+    seq, decisions = drive(graph, platform, fresh())
     result = LocalResult()
     cur = benchmarker.benchmark(seq, opts.bench_opts)
     result.sims.append(SimResult(order=seq, result=cur))
+    seen = {canonical_key(seq)}
     spent = 1
 
     def sweep_order(decs):
@@ -184,7 +192,8 @@ def hill_climb(
         biggest schedule differences."""
         struct = [i for i, d in enumerate(decs)
                   if isinstance(d, (ChooseOp, AssignLane))]
-        rest = [i for i in range(len(decs)) if i not in set(struct)]
+        struct_set = set(struct)
+        rest = [i for i in range(len(decs)) if i not in struct_set]
         rng.shuffle(struct)
         rng.shuffle(rest)
         return struct + rest
@@ -202,8 +211,15 @@ def hill_climb(
             rng.shuffle(alts)
             for alt in alts[: opts.max_alts_per_step]:
                 cand_seq, cand_dec = replay_with_substitution(
-                    graph, platform, decisions, i, alt, fallback
+                    graph, platform, decisions, i, alt, fresh()
                 )
+                key = canonical_key(cand_seq)
+                if key in seen:
+                    # a no-op neighbor (e.g. swapping which of two Expands
+                    # goes first yields the identical schedule) — skip
+                    # WITHOUT charging the budget
+                    continue
+                seen.add(key)
                 res = benchmarker.benchmark(cand_seq, opts.bench_opts)
                 result.sims.append(SimResult(order=cand_seq, result=res))
                 spent += 1
