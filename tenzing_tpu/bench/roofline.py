@@ -92,7 +92,8 @@ def halo_cost(nq: int, lx: int, ly: int, lz: int, radius: int,
 
 def spmv_cost(m: int, nnz: int, bytes_per_el: int = 4) -> Cost:
     """CSR y = A x: 2 FLOPs per stored element; HBM reads vals + cols +
-    gathered x + row offsets, writes y."""
+    gathered x per stored element, plus per row one y write and one 4-byte
+    row-offset read (ADVICE r3: the per-row term is y + offsets only)."""
     flops = 2.0 * nnz
-    hbm = float(nnz) * (2 * bytes_per_el + 4) + float(m) * (2 * bytes_per_el + 4)
+    hbm = float(nnz) * (2 * bytes_per_el + 4) + float(m) * (bytes_per_el + 4)
     return Cost(flops=flops, hbm_bytes=hbm)

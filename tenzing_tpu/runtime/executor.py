@@ -212,6 +212,21 @@ class TraceContext:
         self._lane_tok[waiter.id] = self._join(self._lane(waiter), self._lane(waitee))
 
 
+def _check_inflight_drained(tc: "TraceContext") -> None:
+    """End-of-trace guard: a split-kernel transfer posted without a matching
+    await would leave its wait closure in ``tc.inflight`` and downstream
+    consumers would read an in-flight buffer on TPU — a *silent* data race.
+    Every schedule the solvers emit pairs post with await (the graph contains
+    both), so leftovers are a graph-construction bug; fail loudly (ADVICE r3)."""
+    if tc.inflight:
+        raise ValueError(
+            "schedule ended with un-awaited in-flight transfers for buffers "
+            f"{sorted(tc.inflight)}; every split-kernel post (e.g. "
+            "RdmaCopyStart) needs a matching AwaitTransfer/MultiAwait in the "
+            "schedule"
+        )
+
+
 class TraceExecutor:
     """Compiles schedules to XLA programs and runs them (the ``ScheduleRunner``
     the EmpiricalBenchmarker consumes).
@@ -277,6 +292,7 @@ class TraceExecutor:
         )
         for op in ops:
             op.trace(tc)
+        _check_inflight_drained(tc)
         return tc.bufs
 
     @staticmethod
@@ -377,6 +393,7 @@ class TraceExecutor:
                 )
                 for op in ops:
                     op.trace(tc)
+                _check_inflight_drained(tc)
                 return (tc.bufs, tc.token_state())
 
             mesh = self.platform.mesh

@@ -147,7 +147,9 @@ def replay_with_substitution(
 class LocalOpts:
     """``budget`` counts benchmarked DISTINCT schedules: canonical-key
     dedup skips no-op neighbors (a substitution that rebuilds the identical
-    schedule) without charging the budget."""
+    schedule) without charging the budget, and a neighbor already measured by
+    an earlier solver through a shared ``CachingBenchmarker`` (cache hit —
+    instant, no device time) is likewise free (ADVICE r3)."""
 
     budget: int = 24
     bench_opts: BenchOpts = field(default_factory=BenchOpts)
@@ -181,10 +183,13 @@ def hill_climb(
     fresh = lambda: phase_policy(platform, phases, prefer)
     seq, decisions = drive(graph, platform, fresh())
     result = LocalResult()
+    pre_hits = getattr(benchmarker, "hits", None)
     cur = benchmarker.benchmark(seq, opts.bench_opts)
     result.sims.append(SimResult(order=seq, result=cur))
     seen = {canonical_key(seq)}
-    spent = 1
+    # the incumbent's own benchmark charges the budget only when it cost
+    # device time (same free-cache-hit policy as the neighbor loop below)
+    spent = 0 if pre_hits is not None and benchmarker.hits > pre_hits else 1
 
     def sweep_order(decs):
         """Shuffled positions, structural decisions (implementation choices,
@@ -220,9 +225,11 @@ def hill_climb(
                     # WITHOUT charging the budget
                     continue
                 seen.add(key)
+                pre_hits = getattr(benchmarker, "hits", None)
                 res = benchmarker.benchmark(cand_seq, opts.bench_opts)
                 result.sims.append(SimResult(order=cand_seq, result=res))
-                spent += 1
+                if pre_hits is None or benchmarker.hits == pre_hits:
+                    spent += 1  # cache hits cost no device time: don't charge
                 if res.pct50 < cur.pct50:  # first improvement: move
                     cur, seq, decisions = res, cand_seq, cand_dec
                     improved = True

@@ -22,9 +22,16 @@ import glob
 from pathlib import Path
 from typing import Dict, List, Sequence as Seq, Tuple
 
-TRANSFER_KEYWORDS = ("copy", "dma", "transfer", "infeed", "outfeed", "send", "recv")
+TRANSFER_KEYWORDS = ("copy", "dma", "transfer", "infeed", "outfeed", "send",
+                     "recv", "all-reduce", "reduce-scatter", "all-gather",
+                     "all-to-all", "collective", "permute")
 COMPUTE_KEYWORDS = ("fusion", "dynamic", "slice", "pad", "convert", "reshape",
-                    "add", "concatenate")
+                    "add", "concatenate", "custom-call", "custom_call", "dot",
+                    "matmul", "gelu", "broadcast", "select", "iota",
+                    "transpose", "mosaic")
+# outer control events span the whole program and would make every DMA look
+# concurrent — they are neither transfer nor compute nor "unclassified"
+CONTROL_KEYWORDS = ("while", "loop", "condition", "body", "call", "region")
 
 
 def capture_trace(executor, order, out_dir, iters: int = 3) -> Tuple[Path, float]:
@@ -69,6 +76,7 @@ def analyze_trace(trace_dir) -> Dict[str, float]:
     data = ProfileData.from_file(paths[-1])
     xfers: List[Tuple[int, int]] = []
     computes: List[Tuple[int, int]] = []
+    unclassified: List[Tuple[int, int]] = []
     for plane in data.planes:
         pname = plane.name.lower()
         if not ("tpu" in pname or "device" in pname or "xla" in pname):
@@ -83,6 +91,10 @@ def analyze_trace(trace_dir) -> Dict[str, float]:
                     xfers.append(iv)
                 elif any(k in nm for k in COMPUTE_KEYWORDS):
                     computes.append(iv)
+                elif not any(k in nm for k in CONTROL_KEYWORDS):
+                    # neither transfer, compute, nor outer control: report it
+                    # so silent misclassification is visible (ADVICE r3)
+                    unclassified.append(iv)
 
     def total(ivs):
         return sum(b - a for a, b in merge_intervals(ivs))
@@ -100,7 +112,9 @@ def analyze_trace(trace_dir) -> Dict[str, float]:
         "xplane": paths[-1],
         "n_transfer_events": len(xfers),
         "n_compute_events": len(computes),
+        "n_unclassified_events": len(unclassified),
         "transfer_busy_ms": total(xfers) / 1e6,
         "compute_busy_ms": total(computes) / 1e6,
+        "unclassified_busy_ms": total(unclassified) / 1e6,
         "transfer_concurrent_with_compute_ms": overlap_ns / 1e6,
     }
