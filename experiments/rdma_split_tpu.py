@@ -1,0 +1,109 @@
+"""Hardware validation of the split (post/wait) remote-DMA kernels.
+
+Runs on the real TPU (no JAX_PLATFORMS=cpu): exercises the semaphore-passing
+split kernels of ops/rdma.py that the Pallas interpreter cannot represent —
+(a) the loopback copy split (``rdma_start_loopback``/``rdma_wait_loopback``),
+(b) the mesh-shift split on a size-1 axis (``rdma_shift_post``/
+``rdma_shift_wait`` — degenerates to the loopback descriptor, which is the
+only shift the one-chip environment can execute for real), and (c) the
+``RdmaShiftStart`` op end-to-end through the TraceExecutor with a separate
+``AwaitTransfer`` settling the in-flight semaphores (VERDICT r3 item 2's
+loopback-on-hardware leg; the multi-chip structure leg is the 8-CPU dryrun).
+
+Writes experiments/RDMA_SPLIT_TPU.json.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tenzing_tpu.ops.rdma import (
+        rdma_shift_post,
+        rdma_shift_wait,
+        rdma_start_loopback,
+        rdma_wait_loopback,
+    )
+
+    out = {"device": str(jax.devices()[0]), "checks": {}}
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((4096, 1024), dtype=np.float32))  # 16 MB
+
+    # (a) loopback copy split
+    @jax.jit
+    def loop_split(x):
+        send, recv, y = rdma_start_loopback(x)
+        return rdma_wait_loopback(x, send, recv, y)
+
+    y = jax.device_get(loop_split(x))
+    assert np.array_equal(y, np.asarray(x)), "loopback split mismatch"
+    out["checks"]["loopback_copy_split"] = "allclose"
+
+    # (b) mesh-shift split, size-1 axis (loopback descriptor)
+    @jax.jit
+    def shift_split(x):
+        send, recv, y = rdma_shift_post(x, (), None, 1)
+        return rdma_shift_wait(x, send, recv, y, (), None, 1)
+
+    y = jax.device_get(shift_split(x))
+    assert np.array_equal(y, np.asarray(x)), "shift split mismatch"
+    out["checks"]["shift_split_axis1"] = "allclose"
+
+    # (c) RdmaShiftStart + AwaitTransfer through the executor: the post op
+    # stashes the wait closure in ctx.inflight, the await runs the wait kernel
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.operation import DeviceOp
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.ops.comm_ops import AwaitTransfer
+    from tenzing_tpu.ops.rdma import RdmaShiftStart
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.dfs import get_all_sequences
+
+    class Scale(DeviceOp):
+        def __init__(self):
+            super().__init__("scale")
+
+        def reads(self):
+            return ["y"]
+
+        def writes(self):
+            return ["z"]
+
+        def apply(self, bufs, ctx):
+            return {"z": 2.0 * bufs["y"]}
+
+    g = Graph()
+    post = RdmaShiftStart("shift", "x", "y", axis="sp", shift=1)
+    await_ = AwaitTransfer("await_y", "y")
+    scale = Scale()
+    g.start_then(post)
+    g.then(post, await_)
+    g.then(await_, scale)
+    g.then_finish(scale)
+    plat = Platform.make_n_lanes(2)
+    bufs = {"x": x, "y": jnp.zeros_like(x), "z": jnp.zeros_like(x)}
+    ex = TraceExecutor(plat, bufs)
+    st = get_all_sequences(g, plat, max_seqs=1)[0]
+    res = ex.run(st.sequence)
+    assert np.array_equal(jax.device_get(res["z"]), 2.0 * np.asarray(x))
+    ops = [op.desc() for op in st.sequence.vector()]
+    assert any("shift" in o for o in ops) and any("await_y" in o for o in ops)
+    out["checks"]["executor_shift_post_await"] = {
+        "schedule": ops, "result": "allclose",
+    }
+
+    path = Path(__file__).parent / "RDMA_SPLIT_TPU.json"
+    path.write_text(json.dumps(out, indent=1))
+    print(json.dumps(out["checks"], indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -181,15 +181,21 @@ def hill_climb(
     # (position, alternative) neighbor maps to depend on how many fallback
     # assignments happened earlier in the run
     fresh = lambda: phase_policy(platform, phases, prefer)
-    seq, decisions = drive(graph, platform, fresh())
     result = LocalResult()
-    pre_hits = getattr(benchmarker, "hits", None)
-    cur = benchmarker.benchmark(seq, opts.bench_opts)
-    result.sims.append(SimResult(order=seq, result=cur))
+
+    def measured(seq_):
+        """Benchmark + record; returns (result, charge) where ``charge`` is
+        False for a cache hit (instant, no device time) — the single
+        free-cache-hit policy both the incumbent and the neighbor loop use."""
+        pre_hits = getattr(benchmarker, "hits", None)
+        res = benchmarker.benchmark(seq_, opts.bench_opts)
+        result.sims.append(SimResult(order=seq_, result=res))
+        return res, pre_hits is None or benchmarker.hits == pre_hits
+
+    seq, decisions = drive(graph, platform, fresh())
+    cur, charge = measured(seq)
     seen = {canonical_key(seq)}
-    # the incumbent's own benchmark charges the budget only when it cost
-    # device time (same free-cache-hit policy as the neighbor loop below)
-    spent = 0 if pre_hits is not None and benchmarker.hits > pre_hits else 1
+    spent = 1 if charge else 0
 
     def sweep_order(decs):
         """Shuffled positions, structural decisions (implementation choices,
@@ -225,10 +231,8 @@ def hill_climb(
                     # WITHOUT charging the budget
                     continue
                 seen.add(key)
-                pre_hits = getattr(benchmarker, "hits", None)
-                res = benchmarker.benchmark(cand_seq, opts.bench_opts)
-                result.sims.append(SimResult(order=cand_seq, result=res))
-                if pre_hits is None or benchmarker.hits == pre_hits:
+                res, charge = measured(cand_seq)
+                if charge:
                     spent += 1  # cache hits cost no device time: don't charge
                 if res.pct50 < cur.pct50:  # first improvement: move
                     cur, seq, decisions = res, cand_seq, cand_dec

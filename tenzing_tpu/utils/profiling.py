@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence as Seq, Tuple
 
 TRANSFER_KEYWORDS = ("copy", "dma", "transfer", "infeed", "outfeed", "send",
                      "recv", "all-reduce", "reduce-scatter", "all-gather",
-                     "all-to-all", "collective", "permute")
+                     "all-to-all", "collective", "permute", "rdma")
 COMPUTE_KEYWORDS = ("fusion", "dynamic", "slice", "pad", "convert", "reshape",
                     "add", "concatenate", "custom-call", "custom_call", "dot",
                     "matmul", "gelu", "broadcast", "select", "iota",

@@ -37,14 +37,16 @@ def make_setup(args=None, mesh_shape=(2, 2, 2)):
 
 def test_graph_shape():
     g = add_to_graph(Graph(), HaloArgs())
-    # 6 directions x (pack, exchange, unpack) + start/finish
-    assert g.vertex_size() == 20
+    # 6 directions x (pack, post, await, unpack) + start/finish: the post and
+    # the wait are separate vertices (reference Isend/Wait split)
+    assert g.vertex_size() == 26
     for d in DIRECTIONS:
         n = dir_name(d)
-        from tenzing_tpu.models.halo import Pack
 
         pack = [v for v in g.vertices() if v.name() == f"pack_{n}"][0]
-        assert [s.name() for s in g.succs(pack)] == [f"exchange_{n}"]
+        assert [s.name() for s in g.succs(pack)] == [f"exchange_{n}.xla"]
+        post = g.succs(pack)[0]
+        assert [s.name() for s in g.succs(post)] == [f"await_{n}"]
 
 
 def test_halo_exchange_correct_2x2x2():
