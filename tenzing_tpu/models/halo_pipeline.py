@@ -269,6 +269,47 @@ def greedy_overlap_order(args: HaloArgs, platform, engine: str = "host") -> Sequ
                               HALO_PHASES)
 
 
+def paired_priority(engine: str = "mixed"):
+    """Per-op priority for the PAIRED overlap discipline: all packs, all
+    posts, then per-direction ``await_d -> unpack_d`` pairs — each face is
+    unpacked as soon as ITS transfer lands instead of after ALL transfers
+    land (the phase discipline's all-awaits barrier).  Directions are visited
+    fastest-engine-first: with ``engine='mixed'`` the on-chip DMA dirs
+    (even DIRECTIONS indices) complete in microseconds and their unpacks run
+    while the host round trips are still in flight — exactly the overlap the
+    post/wait split exists to expose (reference Wait placement freedom,
+    ops_mpi.hpp:121-131).  For phase_policy(priority=...) and the climb."""
+    order = sorted(range(len(DIRECTIONS)),
+                   key=lambda i: (i % 2 if engine == "mixed" else 0, i))
+    rank = {dir_name(DIRECTIONS[i]): r for r, i in enumerate(order)}
+
+    def priority(name: str) -> int:
+        if name.startswith(("start",)):
+            return 0
+        if name.startswith("pack"):
+            return 1
+        if name.startswith(("spill", "fetch", "xfer")):
+            return 2
+        if name.startswith(("await", "unpack")):
+            d = name.split("_", 1)[1].split(".", 1)[0]
+            return 10 + 2 * rank[d] + (0 if name.startswith("await") else 1)
+        return 99  # finish
+
+    return priority
+
+
+def paired_overlap_order(args: HaloArgs, platform, engine: str = "mixed") -> Sequence:
+    """The paired await/unpack incumbent schedule (see :func:`paired_priority`),
+    derived through the SDP machinery like the greedy incumbents."""
+    from tenzing_tpu.solve.local import drive, phase_policy
+
+    seq, _ = drive(
+        build_graph(args, engine=engine), platform,
+        phase_policy(platform, HALO_PHASES, priority=paired_priority(engine)),
+    )
+    return seq
+
+
 def _padded_shape(shape: Tuple[int, int, int, int]) -> Tuple[int, int, int, int]:
     """U allocated with trailing dims padded to TPU tiling (8 sublanes x 128
     lanes): Mosaic requires HBM plane DMAs tile-aligned (ops/halo_pallas.py),

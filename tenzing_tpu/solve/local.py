@@ -34,17 +34,25 @@ from tenzing_tpu.core.state import (
 
 
 def phase_policy(platform, phases: Seq[str],
-                 prefer: Optional[Callable[[str, List[str]], Optional[str]]] = None):
+                 prefer: Optional[Callable[[str, List[str]], Optional[str]]] = None,
+                 priority: Optional[Callable[[str], int]] = None):
     """A policy closure for :func:`drive`: expand compounds eagerly, resolve
     ChoiceOps via ``prefer(choice_op_name, choice_names) -> chosen name`` (or
     the first choice), round-robin lane bindings, and execute in ``phases``
-    order with the sync-gating discipline of solve/greedy.py."""
+    order with the sync-gating discipline of solve/greedy.py.
+
+    ``priority`` (op name -> int) overrides the prefix-index phase of an op —
+    finer-than-phase disciplines (e.g. the halo paired await/unpack interleave,
+    models/halo_pipeline.paired_priority) express per-op orderings while
+    reusing the same gating machinery."""
     from tenzing_tpu.core.sync_ops import SyncOp
 
     lane_rr = [0]
 
     def phase(op) -> int:
         name = op.name()
+        if priority is not None:
+            return priority(name)
         for i, p in enumerate(phases):
             if name.startswith(p):
                 return i
@@ -149,17 +157,30 @@ class LocalOpts:
     dedup skips no-op neighbors (a substitution that rebuilds the identical
     schedule) without charging the budget, and a neighbor already measured by
     an earlier solver through a shared ``CachingBenchmarker`` (cache hit —
-    instant, no device time) is likewise free (ADVICE r3)."""
+    instant, no device time) is likewise free (ADVICE r3).
+
+    ``paired=True`` makes each accept decision DRIFT-IMMUNE: the neighbor and
+    the current incumbent are measured back-to-back as one decorrelated
+    2-schedule batch and the move is taken only when the paired ratio's
+    bootstrap CI clears 1.0.  Without it, first-improvement climbing under a
+    drifting chip accepts moves because the *chip* sped up between the
+    incumbent's old measurement and the neighbor's new one (observed in the
+    r4 driver: a climb chain "improving" 142 -> 96 ms that ranked below its
+    own seed in the paired screen).  Needs a benchmarker exposing
+    ``benchmark_batch_times`` (EmpiricalBenchmarker, directly or as the
+    ``.inner`` of a CachingBenchmarker)."""
 
     budget: int = 24
     bench_opts: BenchOpts = field(default_factory=BenchOpts)
     seed: int = 0
     max_alts_per_step: int = 3
+    paired: bool = False
 
 
 @dataclass
 class LocalResult:
     sims: List = field(default_factory=list)  # SimResult-compatible entries
+    final: object = None  # the accepted chain tip (the climb's official output)
 
     def best(self):
         return min(self.sims, key=lambda s: s.result.pct50) if self.sims else None
@@ -167,7 +188,7 @@ class LocalResult:
 
 def hill_climb(
     graph: Graph, platform, benchmarker, phases: Seq[str],
-    prefer=None, opts: Optional[LocalOpts] = None,
+    prefer=None, opts: Optional[LocalOpts] = None, priority=None,
 ) -> LocalResult:
     """First-improvement hill climbing from the phase-policy incumbent."""
     from tenzing_tpu.solve.mcts.mcts import SimResult
@@ -180,7 +201,7 @@ def hill_climb(
     # lane counter, and sharing one closure would make the schedule a given
     # (position, alternative) neighbor maps to depend on how many fallback
     # assignments happened earlier in the run
-    fresh = lambda: phase_policy(platform, phases, prefer)
+    fresh = lambda: phase_policy(platform, phases, prefer, priority)
     result = LocalResult()
 
     def measured(seq_):
@@ -191,6 +212,25 @@ def hill_climb(
         res = benchmarker.benchmark(seq_, opts.bench_opts)
         result.sims.append(SimResult(order=seq_, result=res))
         return res, pre_hits is None or benchmarker.hits == pre_hits
+
+    batcher = getattr(benchmarker, "benchmark_batch_times", None)
+    if batcher is None:
+        inner = getattr(benchmarker, "inner", None)
+        batcher = getattr(inner, "benchmark_batch_times", None)
+    use_paired = opts.paired and batcher is not None
+
+    def paired_step(cur_seq, cand_seq):
+        """(candidate BenchResult, accept) from one decorrelated 2-schedule
+        batch: accept only when the paired cur/cand ratio's CI clears 1.0."""
+        from tenzing_tpu.bench.benchmarker import BenchResult
+        from tenzing_tpu.utils.numeric import paired_speedup
+
+        pair_seed = rng.randrange(1 << 30)
+        times = batcher([cur_seq, cand_seq], opts.bench_opts, seed=pair_seed)
+        m, lo, _ = paired_speedup(times[0], times[1], seed=pair_seed + 1)
+        res = BenchResult.from_times(times[1])
+        result.sims.append(SimResult(order=cand_seq, result=res))
+        return res, (m > 1.0 and lo > 1.0)
 
     seq, decisions = drive(graph, platform, fresh())
     cur, charge = measured(seq)
@@ -231,10 +271,15 @@ def hill_climb(
                     # WITHOUT charging the budget
                     continue
                 seen.add(key)
-                res, charge = measured(cand_seq)
-                if charge:
-                    spent += 1  # cache hits cost no device time: don't charge
-                if res.pct50 < cur.pct50:  # first improvement: move
+                if use_paired:
+                    res, accept = paired_step(seq, cand_seq)
+                    spent += 1
+                else:
+                    res, charge = measured(cand_seq)
+                    if charge:
+                        spent += 1  # cache hits are free: don't charge
+                    accept = res.pct50 < cur.pct50
+                if accept:  # first improvement: move
                     cur, seq, decisions = res, cand_seq, cand_dec
                     improved = True
                     break
@@ -242,4 +287,5 @@ def hill_climb(
                     break
             if improved or spent >= opts.budget:
                 break
+    result.final = SimResult(order=seq, result=cur)
     return result

@@ -63,12 +63,16 @@ class Node:
     def ensure_children(self, platform) -> None:
         """Create one child per decision (reference create_children,
         mcts_node.hpp:514-552); Execute decisions become op nodes, graph-only
-        decisions become decision nodes — both are plain children here."""
+        decisions become decision nodes — both are plain children here.
+        Children pre-created by seed materialization are kept, not
+        duplicated (matched by decision key)."""
         if self.expanded_ or self.is_terminal():
             self.expanded_ = True
             return
+        have = {c.decision.key() for c in self.children if c.decision is not None}
         for d in _decisions(self.state, platform):
-            self.children.append(Node(self.state.apply(d), self.strategy, d, self))
+            if d.key() not in have:
+                self.children.append(Node(self.state.apply(d), self.strategy, d, self))
         self.expanded_ = True
         if not self.children:
             self.fully_visited_ = True

@@ -1,0 +1,108 @@
+"""Warm-started MCTS (seed decision paths) + the paired halo discipline."""
+
+import numpy as np
+import pytest
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, CachingBenchmarker
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.core.sequence import canonical_key
+from tenzing_tpu.models.halo import HaloArgs
+from tenzing_tpu.models.halo_pipeline import (
+    HALO_PHASES,
+    build_graph,
+    greedy_overlap_order,
+    host_buffer_names,
+    make_pipeline_buffers,
+    paired_overlap_order,
+    paired_priority,
+)
+from tenzing_tpu.runtime.executor import TraceExecutor
+from tenzing_tpu.solve.local import drive, phase_policy
+from tenzing_tpu.solve.mcts import MctsOpts, explore
+from tenzing_tpu.solve.mcts.strategies import FastMin
+
+ARGS = HaloArgs(nq=2, lx=4, ly=4, lz=4, radius=1)
+
+
+def make_executor(engine="host"):
+    bufs, want = make_pipeline_buffers(ARGS, seed=0)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    return jbufs, want
+
+
+class CountingBench:
+    """Counts real benchmark calls; returns schedule-independent times."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def benchmark(self, order, opts=None):
+        self.calls += 1
+        return BenchResult.from_times([1.0 + 0.001 * self.calls] * 3)
+
+
+def test_paired_order_numerics():
+    """The paired await/unpack incumbent is a legal schedule with correct
+    results, for both the phase and the mixed-engine realizations."""
+    for engine in ("host", "mixed"):
+        bufs, want = make_executor()
+        plat = Platform.make_n_lanes(4)
+        seq = paired_overlap_order(ARGS, plat, engine=engine)
+        names = [op.name() for op in seq.vector()]
+        # paired discipline: each direction's unpack comes right after its own
+        # await, i.e. some await appears AFTER the first unpack (no all-awaits
+        # barrier like the greedy phase discipline)
+        first_unpack = next(i for i, n in enumerate(names) if n.startswith("unpack"))
+        assert any(n.startswith("await") for n in names[first_unpack:]), names
+        ex = TraceExecutor(plat, bufs)
+        out = ex.run(seq)
+        np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
+
+
+def test_paired_differs_from_greedy():
+    plat = Platform.make_n_lanes(4)
+    paired = paired_overlap_order(ARGS, plat, engine="host")
+    greedy = greedy_overlap_order(ARGS, plat, engine="host")
+    assert canonical_key(paired) != canonical_key(greedy)
+
+
+def test_seeded_explore_materializes_path():
+    """Seeds are consumed as the first iterations: the seed schedule is
+    benchmarked exactly as driven, and the tree statistics cover its path."""
+    g = build_graph(ARGS)
+    plat = Platform.make_n_lanes(2)
+    seq, decs = drive(g, plat, phase_policy(plat, HALO_PHASES))
+    bench = CountingBench()
+    res = explore(
+        g, plat, bench,
+        MctsOpts(n_iters=3, bench_opts=BenchOpts(n_iters=2), seed=0,
+                 cache_benchmarks=False),
+        strategy=FastMin,
+        seeds=[decs],
+    )
+    assert len(res.sims) == 3
+    # first sim IS the seed schedule, as recorded (no redundant-sync cleanup)
+    assert canonical_key(res.sims[0].order) == canonical_key(seq)
+    # the seed path was materialized into the tree (visits down the path)
+    assert res.tree_size > len(decs) // 2
+
+
+def test_seeded_explore_cache_hit_free():
+    """A seed whose schedule was pre-benchmarked by the driver is a cache hit
+    — the warm start costs no device time."""
+    g = build_graph(ARGS)
+    plat = Platform.make_n_lanes(2)
+    seq, decs = drive(g, plat, phase_policy(plat, HALO_PHASES))
+    inner = CountingBench()
+    bench = CachingBenchmarker(inner)
+    opts = BenchOpts(n_iters=2)
+    bench.benchmark(seq, opts)  # the driver's incumbent measurement
+    before = inner.calls
+    explore(
+        g, plat, bench,
+        MctsOpts(n_iters=1, bench_opts=opts, seed=0),
+        strategy=FastMin,
+        seeds=[decs],
+    )
+    assert bench.hits >= 1
+    assert inner.calls == before  # seed iteration cost no real benchmark
