@@ -205,11 +205,22 @@ def hill_climb(
     result = LocalResult()
 
     def measured(seq_):
-        """Benchmark + record; returns (result, charge) where ``charge`` is
-        False for a cache hit (instant, no device time) — the single
-        free-cache-hit policy both the incumbent and the neighbor loop use."""
+        """Benchmark + record; returns (result | None, charge) where
+        ``charge`` is False for a cache hit (instant, no device time) — the
+        single free-cache-hit policy both the incumbent and the neighbor loop
+        use.  ``None`` result = the schedule failed to compile/run (rejected,
+        same policy as paired_step)."""
         pre_hits = getattr(benchmarker, "hits", None)
-        res = benchmarker.benchmark(seq_, opts.bench_opts)
+        try:
+            res = benchmarker.benchmark(seq_, opts.bench_opts)
+        except Exception as e:
+            import sys
+
+            sys.stderr.write(
+                "hill-climb: schedule rejected (failed to compile/run: "
+                f"{type(e).__name__}: {str(e)[:200]})\n"
+            )
+            return None, True
         result.sims.append(SimResult(order=seq_, result=res))
         return res, pre_hits is None or benchmarker.hits == pre_hits
 
@@ -220,13 +231,27 @@ def hill_climb(
     use_paired = opts.paired and batcher is not None
 
     def paired_step(cur_seq, cand_seq):
-        """(candidate BenchResult, accept) from one decorrelated 2-schedule
-        batch: accept only when the paired cur/cand ratio's CI clears 1.0."""
+        """(candidate BenchResult | None, accept) from one decorrelated
+        2-schedule batch: accept only when the paired cur/cand ratio's CI
+        clears 1.0.  A neighbor that fails to COMPILE (e.g. an ordering whose
+        liveness needs more HBM than the chip has — observed on the halo
+        flagship: several multi-GB grid versions kept alive at once) is a
+        reject, not a crash: infeasible-on-hardware is a legitimate verdict
+        for a schedule."""
         from tenzing_tpu.bench.benchmarker import BenchResult
         from tenzing_tpu.utils.numeric import paired_speedup
 
         pair_seed = rng.randrange(1 << 30)
-        times = batcher([cur_seq, cand_seq], opts.bench_opts, seed=pair_seed)
+        try:
+            times = batcher([cur_seq, cand_seq], opts.bench_opts, seed=pair_seed)
+        except Exception as e:  # compile/runtime failure of the candidate
+            import sys
+
+            sys.stderr.write(
+                "hill-climb: neighbor rejected (failed to compile/run: "
+                f"{type(e).__name__}: {str(e)[:200]})\n"
+            )
+            return None, False
         m, lo, _ = paired_speedup(times[0], times[1], seed=pair_seed + 1)
         res = BenchResult.from_times(times[1])
         result.sims.append(SimResult(order=cand_seq, result=res))
@@ -234,6 +259,11 @@ def hill_climb(
 
     seq, decisions = drive(graph, platform, fresh())
     cur, charge = measured(seq)
+    if cur is None:
+        raise RuntimeError(
+            "hill-climb incumbent schedule failed to compile/run — nothing "
+            "to climb from"
+        )
     seen = {canonical_key(seq)}
     spent = 1 if charge else 0
 
@@ -278,7 +308,7 @@ def hill_climb(
                     res, charge = measured(cand_seq)
                     if charge:
                         spent += 1  # cache hits are free: don't charge
-                    accept = res.pct50 < cur.pct50
+                    accept = res is not None and res.pct50 < cur.pct50
                 if accept:  # first improvement: move
                     cur, seq, decisions = res, cand_seq, cand_dec
                     improved = True

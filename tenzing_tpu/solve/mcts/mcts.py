@@ -24,7 +24,7 @@ from tenzing_tpu.bench.benchmarker import (
 )
 from tenzing_tpu.core.graph import Graph
 from tenzing_tpu.core.schedule import remove_redundant_syncs
-from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.sequence import Sequence, canonical_key
 from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
 from tenzing_tpu.core.state import State
 from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
@@ -95,7 +95,7 @@ def _dump_cadence(it: int) -> bool:
     return it % 100 == 0
 
 
-def _materialize_seed(root: Node, platform, path) -> tuple:
+def _materialize_seed(root: Node, path) -> tuple:
     """Walk ``path`` (a decision list from ``solve.local.drive``) down the
     tree, creating ONLY the matching child per step (siblings are left for
     ``ensure_children`` to fill lazily when UCT actually visits the node — a
@@ -170,6 +170,7 @@ def explore(
         if root is not None:
             ctx.root = root
         seed_iter = iter(seeds if seeds is not None else ())
+        failed_keys: set = set()  # negative cache for uncompilable schedules
         for it in range(opts.n_iters):
             stop = False
             order: Optional[Sequence] = None
@@ -179,7 +180,7 @@ def explore(
                 path = next(seed_iter, None)
                 if path is not None:
                     with counters.phase("SEED"):
-                        endpoint, st = _materialize_seed(root, platform, path)
+                        endpoint, st = _materialize_seed(root, path)
                         if not st.is_terminal():  # defensive: complete randomly
                             _, order = endpoint.get_rollout(platform, rng)
                         else:
@@ -216,8 +217,40 @@ def explore(
                 if hasattr(op, "events"):
                     events.extend(op.events())
             platform.provision_events(events)
-            with counters.phase("BENCHMARK"):
-                res = benchmarker.benchmark(order, opts.bench_opts)
+            key = canonical_key(order)
+            res: Optional[BenchResult] = None
+            if key not in failed_keys:
+                with counters.phase("BENCHMARK"):
+                    try:
+                        res = benchmarker.benchmark(order, opts.bench_opts)
+                    except Exception as e:
+                        # a rollout whose schedule cannot compile/run on the
+                        # hardware (e.g. liveness exceeding device memory) is
+                        # a legitimate dead end, not a search crash.  Only
+                        # safe single-host: under a multi-host control plane a
+                        # rank-local failure would desync the per-measurement
+                        # barrier/allreduce protocol, so there the error must
+                        # propagate (a crash beats a collective deadlock).
+                        if cp.size() > 1:
+                            raise
+                        sys.stderr.write(
+                            "mcts: rollout rejected (failed to compile/run: "
+                            f"{type(e).__name__}: {str(e)[:200]})\n"
+                        )
+                        failed_keys.add(key)
+            if res is None:
+                # negative-cached or fresh failure: backprop a penalty (2x
+                # the worst time seen) so the tree learns to avoid the
+                # region without re-paying the failing compile; no sim is
+                # recorded (no fake measurements in the result set)
+                worst = max(
+                    (s.result.pct50 for s in result.sims), default=1.0
+                )
+                pen = BenchResult.from_times([2.0 * worst])
+                if cp.rank() == 0:
+                    with counters.phase("BACKPROP"):
+                        endpoint.backprop(ctx, pen)
+                continue
             result.sims.append(SimResult(order=order, result=res))
             if cp.rank() == 0:
                 with counters.phase("BACKPROP"):

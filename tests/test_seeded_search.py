@@ -17,7 +17,7 @@ from tenzing_tpu.models.halo_pipeline import (
     paired_priority,
 )
 from tenzing_tpu.runtime.executor import TraceExecutor
-from tenzing_tpu.solve.local import drive, phase_policy
+from tenzing_tpu.solve.local import LocalOpts, drive, phase_policy
 from tenzing_tpu.solve.mcts import MctsOpts, explore
 from tenzing_tpu.solve.mcts.strategies import FastMin
 
@@ -106,3 +106,43 @@ def test_seeded_explore_cache_hit_free():
     )
     assert bench.hits >= 1
     assert inner.calls == before  # seed iteration cost no real benchmark
+
+
+def test_solvers_survive_uncompilable_schedules():
+    """A schedule that fails to compile/run is a reject (climb) or a
+    penalized dead end (MCTS) — never a crash (observed on hardware: a climb
+    neighbor whose liveness exceeded HBM)."""
+    from tenzing_tpu.solve.local import hill_climb as hc
+
+    g = build_graph(ARGS)
+    plat = Platform.make_n_lanes(2)
+
+    class FlakyBench:
+        """Fails every benchmark except the first (the incumbent)."""
+
+        def __init__(self):
+            self.calls = 0
+
+        def benchmark(self, order, opts=None):
+            self.calls += 1
+            if self.calls > 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of hbm")
+            return BenchResult.from_times([1.0] * 3)
+
+    res = hc(g, plat, FlakyBench(), phases=HALO_PHASES,
+             opts=LocalOpts(budget=5, bench_opts=BenchOpts(n_iters=2)))
+    assert res.final is not None  # incumbent survives; neighbors rejected
+    assert len(res.sims) == 1
+
+    class AlwaysFail:
+        def benchmark(self, order, opts=None):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of hbm")
+
+    mres = explore(
+        g, plat, AlwaysFail(),
+        MctsOpts(n_iters=2, bench_opts=BenchOpts(n_iters=2), seed=0,
+                 cache_benchmarks=False),
+        strategy=FastMin,
+    )
+    assert mres.sims == []  # no fake measurements recorded
+    assert mres.tree_size > 1  # the search still ran and backpropagated
