@@ -122,7 +122,12 @@ def unpack_face_pallas(
 
 class PackPallas(PackFlat):
     """Pack via the plane-DMA kernel, then flatten to the (rows, 128) staging
-    layout (menu alternative to the XLA slice)."""
+    layout (menu alternative to the XLA slice).
+
+    INDEX_TIE stays OFF: the Pallas grid needs static start indices, so this
+    variant keeps the value-tied read (the executor's default)."""
+
+    INDEX_TIE = False
 
     def __init__(self, args: HaloArgs, d):
         super().__init__(args, d)

@@ -207,3 +207,31 @@ def test_greedy_overlap_order_legal_disciplined_and_correct():
     ex, want = _executor()
     out = ex.run(order)
     np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
+
+
+def test_index_tie_survives_compilation():
+    """The INDEX_TIE pack's token edge must survive XLA compilation as a
+    DYNAMIC slice start (the select-derived zero on the direction axis).
+    Guards against a clamp-analysis improvement folding it to a static slice
+    — which would compile every halo schedule to the same unordered program
+    (probed: adding the zero on a full-extent axis was folded exactly so)."""
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.models.halo_pipeline import (
+        build_graph,
+        host_buffer_names,
+        make_pipeline_buffers,
+        naive_order,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    args = HaloArgs(nq=1, lx=8, ly=8, lz=8, radius=2)
+    bufs, _ = make_pipeline_buffers(args, seed=0, with_expected=False)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, jbufs)
+    seq = naive_order(args, Platform.make_n_lanes(1))
+    compiled = ex.compiled_text(seq)
+    assert "dynamic-slice" in compiled, (
+        "pack token edges folded to static slices — INDEX_TIE ordering lost"
+    )
