@@ -487,12 +487,21 @@ def main() -> int:
                 return next((c for c in choices if c.endswith(want)), None)
             return next((c for c in choices if c.endswith(".xla")), None)
 
-        # two climbs, one from each of the strongest disciplines seen in the
-        # r4c final (paired-8l and mixed-6l), splitting --climb-budget 4:3
+        def rdma_prefer(op_name, choices):
+            if op_name.startswith("xfer_"):
+                return next((c for c in choices if c.endswith(".rdma")), None)
+            return next((c for c in choices if c.endswith(".xla")), None)
+
+        # two climbs seeded at the strongest post-index-tie disciplines (the
+        # r4e final: all-rdma at 2-3 lanes leads, paired-6l third), splitting
+        # --climb-budget 4:3: one refines the rdma-3l winner (kernel flips —
+        # e.g. the aliased Pallas unpack — plus order/lane moves), one climbs
+        # the paired-interleave variant of the same engine assignment
         b1 = (args.climb_budget * 4) // 7
+        plat3 = Platform.make_n_lanes(3)
         climb_cfg = [
-            (plat, HALO_PHASES, halo_prefer, paired_priority("mixed"), b1),
-            (Platform.make_n_lanes(6), HALO_PHASES, halo_prefer, None,
+            (plat3, HALO_PHASES, rdma_prefer, None, b1),
+            (plat3, HALO_PHASES, rdma_prefer, paired_priority("rdma"),
              args.climb_budget - b1),
         ]
     elif args.workload == "moe" and not args.smoke:
