@@ -639,19 +639,39 @@ def main() -> int:
     finals = []
     top = []
     if cands:
-        t0 = time.time()
         screen_opts = replace(opts, target_secs=5 * opts.target_secs)
-        _, screen = batch_paired([s.order for s in cands], screen_opts, seed=1)
-        sys.stderr.write(
-            "screen (paired vs naive, wall %.0fs): %s\n"
-            % (
-                time.time() - t0,
-                ", ".join(
-                    "%s=%.4f" % (label_of(s), p[0])
-                    for s, p in zip(cands, screen)
-                ),
+        for attempt in range(2):
+            t0 = time.time()
+            _, screen = batch_paired(
+                [s.order for s in cands], screen_opts, seed=1 + 10 * attempt
             )
-        )
+            sys.stderr.write(
+                "screen (paired vs naive, wall %.0fs): %s\n"
+                % (
+                    time.time() - t0,
+                    ", ".join(
+                        "%s=%.4f" % (label_of(s), p[0])
+                        for s, p in zip(cands, screen)
+                    ),
+                )
+            )
+            # DEGENERATE-SCREEN guard: the tunnel has a slow regime in which
+            # every measurement is latency-dominated and all paired ratios
+            # collapse toward 1.0 (observed: a MoE screen ranking everything
+            # 0.95-1.05 minutes before the final batch measured the same
+            # candidates at 10.9-12.2x).  A screen is suspect only when it
+            # separates nothing (max ratio < 1.1) while the search-time
+            # medians PREDICTED real separation (naive vs best candidate
+            # >= 1.5x) — honest no-win workloads (SpMV ~1.0 everywhere)
+            # never trip it.  One re-run, then the measurement stands.
+            predicted = naive.pct50 / min(s.result.pct50 for s in cands)
+            degenerate = max(p[0] for p in screen) < 1.1 and predicted > 1.5
+            if not degenerate or attempt == 1:
+                break
+            sys.stderr.write(
+                "screen degenerate (all ratios ~1.0, search predicted "
+                f"{predicted:.2f}x) — re-running once\n"
+            )
         ranked = sorted(
             zip(cands, screen), key=lambda sp: sp[1][0], reverse=True
         )
