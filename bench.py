@@ -199,11 +199,11 @@ def main() -> int:
     ap.add_argument("--halo-n", type=int, default=512, help="cells per side (halo)")
     ap.add_argument("--lanes", type=int, default=None,
                     help="search-platform lanes (default: 8 for halo, else 2)")
-    ap.add_argument("--mcts-iters", type=int, default=48, help="MCTS iterations (compile budget)")
+    ap.add_argument("--mcts-iters", type=int, default=40, help="MCTS iterations (compile budget)")
     ap.add_argument("--iters", type=int, default=20, help="measurements per schedule (screen/final)")
     ap.add_argument("--search-iters", type=int, default=6,
                     help="measurements per schedule during MCTS (cheap phase)")
-    ap.add_argument("--climb-budget", type=int, default=56,
+    ap.add_argument("--climb-budget", type=int, default=44,
                     help="hill-climb benchmark budget after MCTS")
     ap.add_argument("--dump-csv", default=None, help="write searched results as CSV rows")
     args = ap.parse_args()
