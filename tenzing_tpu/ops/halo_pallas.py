@@ -8,13 +8,15 @@ Unpack::run) — a per-workload implementation choice the search explores.
 
 TPU-native menu: the XLA path (``models/halo.Pack``/``Unpack``) lowers the face
 slice to XLA's fusion machinery; this module is the alternative — an explicit
-**plane-DMA kernel**: per (q, face-row) grid step the full (Y, Z) plane is
-DMA'd between HBM and VMEM with ``pltpu.make_async_copy`` and the unaligned
-face window is extracted (pack) or merged (unpack read-modify-write) in
-registers.  Mosaic requires HBM DMA slices to be 128-lane aligned (probed on
-v5e: "Slice shape along dimension 3 must be aligned to tiling (128)"), so the
-ragged face cut lives in VMEM — trading extra plane bandwidth for aligned DMA,
-vs the XLA path's fused narrow copy.  Which wins per face shape (x-faces are
+**window-DMA kernel**: per (q, face-row) grid step the tile-aligned BOUNDING
+WINDOW of the face cut (``_tile_window``) is DMA'd between HBM and VMEM with
+``pltpu.make_async_copy`` and the ragged face cut is extracted (pack) or
+merged (unpack read-modify-write, input/output-aliased: guaranteed in place)
+in registers.  Mosaic requires HBM DMA slices tile-aligned (probed on v5e:
+"Slice shape along dimension 3 must be aligned to tiling (128)"), so the
+window is the aligned superset of the cut — a few extra aligned bytes for
+aligned DMA, vs the XLA path's fused narrow copy whose in-place lowering
+depends on XLA's liveness analysis.  Which wins per face shape (x-faces are
 lane-contiguous, z-faces are 3-element strided in the lane dim) is exactly the
 storage-order question the reference's two kernel families answer — so it is
 exposed as a ChoiceOp and searched (SpMV's kernel menu precedent,
@@ -50,25 +52,46 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _tile_window(y0: int, sy: int, z0: int, sz: int,
+                 Y: int, Z: int, itemsize: int = 4) -> Tuple[int, int, int, int]:
+    """(wy0, WH, wz0, WW): the tile-aligned bounding window of the face cut,
+    clamped to the plane extents — Mosaic requires HBM DMA slices
+    tile-aligned (probed on v5e; flagship grids are tile-padded by
+    ``halo_pipeline._padded_shape`` so the clamp is inert there), and DMAing
+    only the window instead of the full plane cuts the moved bytes up to 30x
+    for sublane-thin faces (y-faces: one sublane-tile stripe) and 5x for
+    lane-thin faces (z-faces: a (Y, 128) stripe).  The sublane tile scales
+    with dtype width (8 for 4-byte, 16 for 2-byte, 32 for 1-byte)."""
+    st = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    wy0 = (y0 // st) * st
+    wy1 = min(-(-(y0 + sy) // st) * st, Y)
+    wz0 = (z0 // 128) * 128
+    wz1 = min(-(-(z0 + sz) // 128) * 128, Z)
+    return wy0, wy1 - wy0, wz0, wz1 - wz0
+
+
 @functools.partial(
     jax.jit, static_argnames=("starts", "sizes", "interpret")
 )
 def pack_face_pallas(
     u: jax.Array, starts: Tuple[int, ...], sizes: Tuple[int, ...], interpret: bool = False
 ) -> jax.Array:
-    """out[q, i, :, :] = u[q, x0+i, y0:y0+sy, z0:z0+sz]: full-plane DMA in,
-    ragged face window extracted in VMEM."""
+    """out[q, i, :, :] = u[q, x0+i, y0:y0+sy, z0:z0+sz]: aligned bounding
+    -window DMA in, ragged face cut extracted in VMEM."""
     nq, sx, sy, sz = sizes
     _, x0, y0, z0 = starts
     _, _, Y, Z = u.shape
+    wy0, WH, wz0, WW = _tile_window(y0, sy, z0, sz, Y, Z, u.dtype.itemsize)
 
-    def kernel(u_ref, o_ref, plane, sem):
+    def kernel(u_ref, o_ref, win, sem):
         q = pl.program_id(0)
         i = pl.program_id(1)
-        cp = pltpu.make_async_copy(u_ref.at[q, x0 + i], plane, sem)
+        cp = pltpu.make_async_copy(
+            u_ref.at[q, x0 + i, pl.ds(wy0, WH), pl.ds(wz0, WW)], win, sem
+        )
         cp.start()
         cp.wait()
-        o_ref[0, 0] = plane[y0 : y0 + sy, z0 : z0 + sz]
+        o_ref[0, 0] = win[y0 - wy0 : y0 - wy0 + sy, z0 - wz0 : z0 - wz0 + sz]
 
     return pl.pallas_call(
         kernel,
@@ -76,7 +99,7 @@ def pack_face_pallas(
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec((1, 1, sy, sz), lambda q, i: (q, i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nq, sx, sy, sz), u.dtype),
-        scratch_shapes=[pltpu.VMEM((Y, Z), u.dtype), pltpu.SemaphoreType.DMA],
+        scratch_shapes=[pltpu.VMEM((WH, WW), u.dtype), pltpu.SemaphoreType.DMA],
         interpret=interpret,
     )(u)
 
@@ -85,20 +108,27 @@ def pack_face_pallas(
 def unpack_face_pallas(
     u: jax.Array, face: jax.Array, starts: Tuple[int, ...], interpret: bool = False
 ) -> jax.Array:
-    """u[q, x0+i, y0:y0+sy, z0:z0+sz] = face[q, i, :, :], in place (aliased):
-    read-modify-write of each touched plane through VMEM."""
+    """u[q, x0+i, y0:y0+sy, z0:z0+sz] = face[q, i, :, :], in place (aliased —
+    GUARANTEED, unlike a dynamic-update-slice whose in-place lowering depends
+    on XLA's liveness analysis of the surrounding schedule): read-modify
+    -write of each touched aligned bounding window through VMEM."""
     nq, sx, sy, sz = face.shape
     _, x0, y0, z0 = starts
     _, _, Y, Z = u.shape
+    wy0, WH, wz0, WW = _tile_window(y0, sy, z0, sz, Y, Z, u.dtype.itemsize)
 
-    def kernel(u_ref, f_ref, o_ref, plane, sem):
+    def kernel(u_ref, f_ref, o_ref, win, sem):
         q = pl.program_id(0)
         i = pl.program_id(1)
-        cp_in = pltpu.make_async_copy(u_ref.at[q, x0 + i], plane, sem)
+        cp_in = pltpu.make_async_copy(
+            u_ref.at[q, x0 + i, pl.ds(wy0, WH), pl.ds(wz0, WW)], win, sem
+        )
         cp_in.start()
         cp_in.wait()
-        plane[y0 : y0 + sy, z0 : z0 + sz] = f_ref[0, 0]
-        cp_out = pltpu.make_async_copy(plane, o_ref.at[q, x0 + i], sem)
+        win[y0 - wy0 : y0 - wy0 + sy, z0 - wz0 : z0 - wz0 + sz] = f_ref[0, 0]
+        cp_out = pltpu.make_async_copy(
+            win, o_ref.at[q, x0 + i, pl.ds(wy0, WH), pl.ds(wz0, WW)], sem
+        )
         cp_out.start()
         cp_out.wait()
 
@@ -111,7 +141,7 @@ def unpack_face_pallas(
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
-        scratch_shapes=[pltpu.VMEM((Y, Z), u.dtype), pltpu.SemaphoreType.DMA],
+        scratch_shapes=[pltpu.VMEM((WH, WW), u.dtype), pltpu.SemaphoreType.DMA],
         input_output_aliases={0: 0},
         interpret=interpret,
     )(u, face)
