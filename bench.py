@@ -213,6 +213,10 @@ def main() -> int:
 
         jax.config.update("jax_platforms", "cpu")
 
+    from tenzing_tpu.bench.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     metric_name = metric_for(args.workload, args)
     try:
         devs = probe_backend()
