@@ -462,8 +462,7 @@ def main() -> int:
         import glob as _glob
         import os.path as _osp
 
-        from tenzing_tpu.bench.benchmarker import CsvBenchmarker
-        from tenzing_tpu.core.sequence import canonical_key
+        from tenzing_tpu.bench.recorded import rank_recorded
         from tenzing_tpu.solve.mcts.mcts import SimResult
 
         pat = args.seed_csv
@@ -472,55 +471,10 @@ def main() -> int:
         paths = sorted(_glob.glob(pat))
         if not paths:
             sys.stderr.write(f"recorded db: no files match {pat!r}\n")
-        # rank every row by its paired ratio against ITS OWN FILE's naive
-        # (row 0, final-fidelity by the dump protocol below) — absolute
-        # pct50s are not comparable across files because chip regimes swing
-        # >1.3x between runs, and a cross-regime sort would drop exactly the
-        # discoveries this carries
-        scored = []  # (ratio, seq)
-        n_rows = n_skip = 0
-        for path in paths:
-            try:
-                from tenzing_tpu.bench.benchmarker import CSV_DELIM
-
-                with open(path) as f:
-                    first = f.readline().split(CSV_DELIM)
-                # the dump protocol writes naive as row 0 at final fidelity;
-                # read its pct50 numerically — the naive ops themselves may
-                # not resolve against the menu graph (recorded pre-choice)
-                naive_anchor = (
-                    float(first[3]) if first and first[0] == "0" else None
-                )
-                db = CsvBenchmarker.from_file(path, g, strict=False,
-                                              normalize=True)
-            except Exception as e:
-                sys.stderr.write(f"recorded db: {path} unreadable ({e})\n")
-                continue
-            n_rows += len(db.entries)
-            n_skip += len(db.skipped)
-            if naive_anchor is None:
-                continue  # no in-file naive anchor -> regime unknown
-            for seq_r, res_r in db.entries:
-                if res_r.pct50 > 0:
-                    scored.append((naive_anchor / res_r.pct50, seq_r))
-        scored.sort(key=lambda e: -e[0])
-        seen: set = set()
-        picked = []
-        for ratio, seq_r in scored:
-            if len(picked) >= args.seed_topk:
-                break
-            key = canonical_key(seq_r)
-            if key in seen:
-                continue
-            seen.add(key)
-            picked.append((seq_r, ratio))
-        if paths:
-            sys.stderr.write(
-                f"recorded db: {len(paths)} files, {n_rows} rows "
-                f"({n_skip} skipped), carrying top {len(picked)} by in-file "
-                "ratio: "
-                + ", ".join(f"{r:.3f}" for _, r in picked) + "\n"
-            )
+        picked = rank_recorded(
+            paths, g, args.seed_topk,
+            log=lambda m: sys.stderr.write(m + "\n"),
+        )
         recorded_ok = []
         for ri, (seq_r, ratio) in enumerate(picked):
             t0 = time.time()

@@ -1,0 +1,80 @@
+"""Cross-run search memory: rank recorded search-database rows for warm
+starts.
+
+The driver dumps every run's measured schedules as a CSV database (one row
+per distinct schedule, naive as row 0 at final fidelity — bench.py
+--dump-csv; the reference's mcts_csv checkpoint/replay workflow,
+tenzing-mcts/examples).  ``rank_recorded`` turns a set of such databases
+into the best distinct schedules to carry into the NEXT run as first-class
+candidates and climb seeds.
+
+Ranking is by each row's paired ratio against ITS OWN FILE's naive anchor:
+absolute pct50s are not comparable across files because chip regimes swing
+>1.3x between runs, and a cross-regime sort would drop exactly the
+discoveries worth carrying (observed: the r4k 2.48x winner recorded in a
+40 ms-naive regime vs stale 1.73x rows from a 16 ms regime)."""
+
+from typing import List, Optional, Tuple
+
+from tenzing_tpu.bench.benchmarker import CSV_DELIM, CsvBenchmarker
+from tenzing_tpu.core.sequence import Sequence, canonical_key
+
+
+def naive_anchor_of(path: str) -> Optional[float]:
+    """The file's row-0 pct50, read numerically — the naive ops themselves
+    may not resolve against a later graph (recorded pre-menu), but the
+    anchor only needs the number.  None if the file has no row-0 anchor."""
+    with open(path) as f:
+        first = f.readline().split(CSV_DELIM)
+    try:
+        return float(first[3]) if first and first[0] == "0" else None
+    except (ValueError, IndexError):
+        return None
+
+
+def rank_recorded(
+    paths: List[str], graph, topk: int, log=None
+) -> List[Tuple[Sequence, float]]:
+    """Top ``topk`` distinct recorded schedules across ``paths``, best-first
+    by in-file paired ratio.  Rows that don't resolve against ``graph`` are
+    skipped (strict=False); files without a naive anchor contribute nothing
+    (regime unknown)."""
+    scored: List[Tuple[float, Sequence]] = []
+    n_rows = n_skip = 0
+    for path in paths:
+        try:
+            anchor = naive_anchor_of(path)
+            db = CsvBenchmarker.from_file(path, graph, strict=False,
+                                          normalize=True)
+        except Exception as e:  # unreadable file: report, keep going
+            if log:
+                log(f"recorded db: {path} unreadable ({e})")
+            continue
+        n_rows += len(db.entries)
+        n_skip += len(db.skipped)
+        if anchor is None:
+            continue
+        for seq, res in db.entries:
+            # only rows that beat their own naive are worth carrying (this
+            # also drops the naive row itself, which resolves on menu-less
+            # graphs)
+            if res.pct50 > 0 and anchor / res.pct50 > 1.0:
+                scored.append((anchor / res.pct50, seq))
+    scored.sort(key=lambda e: -e[0])
+    seen: set = set()
+    out: List[Tuple[Sequence, float]] = []
+    for ratio, seq in scored:
+        if len(out) >= topk:
+            break
+        key = canonical_key(seq)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((seq, ratio))
+    if log and paths:
+        log(
+            f"recorded db: {len(paths)} files, {n_rows} rows "
+            f"({n_skip} skipped), carrying top {len(out)} by in-file ratio: "
+            + ", ".join(f"{r:.3f}" for _, r in out)
+        )
+    return out

@@ -1,0 +1,90 @@
+"""Cross-run warm-start ranking (bench/recorded.py): in-file-ratio ranking,
+regime robustness, dedup, anchor handling."""
+
+import numpy as np
+
+from tenzing_tpu.bench.benchmarker import CSV_DELIM, result_row, BenchResult
+from tenzing_tpu.bench.recorded import naive_anchor_of, rank_recorded
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.models.halo import HaloArgs
+from tenzing_tpu.models.halo_pipeline import build_graph, naive_order
+from tenzing_tpu.solve.dfs import get_all_sequences
+
+ARGS = HaloArgs(nq=1, lx=4, ly=4, lz=4, radius=1)
+
+
+def _res(pct50: float) -> BenchResult:
+    return BenchResult(pct01=pct50, pct10=pct50, pct50=pct50,
+                       pct90=pct50, pct99=pct50, stddev=0.0)
+
+
+def _db(path, naive_s, scheds):
+    """Write a synthetic database: naive row 0 + (seq, pct50) rows."""
+    rows = [result_row(0, _res(naive_s), naive_order(ARGS, Platform.make_n_lanes(1)))]
+    for i, (seq, s) in enumerate(scheds):
+        rows.append(result_row(i + 1, _res(s), seq))
+    path.write_text("\n".join(rows) + "\n")
+    return str(path)
+
+
+def test_in_file_ratio_beats_cross_regime_absolute(tmp_path):
+    """A 2x discovery recorded in a slow regime must outrank a 1.2x schedule
+    from a fast regime even though the latter's absolute time is smaller."""
+    g = build_graph(ARGS)
+    plat = Platform.make_n_lanes(2)
+    seqs = [st.sequence for st in get_all_sequences(g, plat, max_seqs=8)]
+    assert len(seqs) >= 3
+    slow = _db(tmp_path / "slow.csv", 0.100, [(seqs[0], 0.050)])  # ratio 2.0
+    fast = _db(tmp_path / "fast.csv", 0.012, [(seqs[1], 0.010)])  # ratio 1.2
+    out = rank_recorded([slow, fast], g, topk=2)
+    assert len(out) == 2
+    assert abs(out[0][1] - 2.0) < 1e-9   # the slow-regime discovery leads
+    assert abs(out[1][1] - 1.2) < 1e-9
+
+
+def test_dedup_and_topk(tmp_path):
+    g = build_graph(ARGS)
+    plat = Platform.make_n_lanes(2)
+    seqs = [st.sequence for st in get_all_sequences(g, plat, max_seqs=8)]
+    # same schedule recorded twice at different ratios -> carried once, best
+    a = _db(tmp_path / "a.csv", 0.100, [(seqs[0], 0.040), (seqs[1], 0.080)])
+    b = _db(tmp_path / "b.csv", 0.100, [(seqs[0], 0.090)])
+    out = rank_recorded([a, b], g, topk=5)
+    ratios = [round(r, 3) for _, r in out]
+    # dup of seqs[0] (1.111 in file b) dropped with its best ratio kept;
+    # naive rows (ratio 1.0) filtered as non-winners
+    assert ratios == [2.5, 1.25]
+    out1 = rank_recorded([a, b], g, topk=1)
+    assert len(out1) == 1 and abs(out1[0][1] - 2.5) < 1e-9
+
+
+def test_missing_anchor_and_unreadable_file(tmp_path):
+    g = build_graph(ARGS)
+    plat = Platform.make_n_lanes(2)
+    seqs = [st.sequence for st in get_all_sequences(g, plat, max_seqs=4)]
+    # file whose first row is not index 0 -> no anchor -> contributes nothing
+    noanchor = tmp_path / "noanchor.csv"
+    noanchor.write_text(result_row(7, _res(0.05), seqs[0]) + "\n")
+    assert naive_anchor_of(str(noanchor)) is None
+    garbled = tmp_path / "garbled.csv"
+    garbled.write_text("not|a|valid|row\n")
+    msgs = []
+    out = rank_recorded([str(noanchor), str(garbled)], g, topk=3,
+                        log=msgs.append)
+    assert out == []
+    assert any("carrying top 0" in m for m in msgs)
+
+
+def test_stale_rows_skipped_against_narrower_graph(tmp_path):
+    """Rows recorded against the menu graph deserialize against the same
+    graph; rows from a DIFFERENT structural variant are skipped, not fatal."""
+    g_menu = build_graph(ARGS, impl_choice=True)
+    plat = Platform.make_n_lanes(2)
+    seqs = [st.sequence for st in get_all_sequences(g_menu, plat, max_seqs=6)]
+    path = _db(tmp_path / "menu.csv", 0.100, [(seqs[-1], 0.025)])
+    # same file read against the plain graph: the naive row (plain ops)
+    # resolves, menu-resolved ops may not — either way no crash
+    g_plain = build_graph(ARGS)
+    out = rank_recorded([path], g_plain, topk=3)
+    for seq, ratio in out:
+        assert ratio > 0
