@@ -259,6 +259,13 @@ def main() -> int:
         out["entries"].append(entry)
         sys.stderr.write(f"{name}: {json.dumps(entry)}\n")
     path = Path(__file__).parent / "EXTERNAL_BASELINES.json"
+    # merge by workload: other scripts (halo_roofline.py) own other entries
+    if path.exists():
+        prev = json.loads(path.read_text())
+        mine = {e.get("workload") for e in out["entries"]}
+        out["entries"] += [
+            e for e in prev.get("entries", []) if e.get("workload") not in mine
+        ]
     path.write_text(json.dumps(out, indent=1))
     print(json.dumps(out))
     return 0

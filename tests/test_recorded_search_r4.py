@@ -1,0 +1,57 @@
+"""Replay of the round-4 recorded searches: the r4k/r4m databases hold the
+batched-z-unpack discovery (paired 2.48-2.55 on TPU v5e) that the warm-start
+machinery carries across runs.  These tests lock the artifacts into the
+suite: the winning schedules must keep deserializing against the menu graph,
+the warm-start ranking must keep surfacing them first, and the recorded
+winner's kernel composition is pinned (batched-Pallas unpacks on both
+z-faces under XLA kernels elsewhere — the combination no hand incumbent
+encodes)."""
+
+import glob
+import os
+
+import pytest
+
+from tenzing_tpu.bench.recorded import naive_anchor_of, rank_recorded
+from tenzing_tpu.core.serdes import sequence_to_json
+from tenzing_tpu.models.halo import HaloArgs
+from tenzing_tpu.models.halo_pipeline import build_graph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GLOB = os.path.join(REPO, "experiments", "halo_search_tpu_r4*.csv")
+
+ARGS = HaloArgs(nq=3, lx=512, ly=512, lz=512, radius=3)
+
+
+@pytest.fixture(scope="module")
+def ranked():
+    g = build_graph(ARGS, impl_choice=True, xfer_choice=True)
+    return rank_recorded(sorted(glob.glob(GLOB)), g, topk=3)
+
+
+def test_databases_have_naive_anchors():
+    paths = sorted(glob.glob(GLOB))
+    assert len(paths) >= 6
+    for p in paths:
+        assert naive_anchor_of(p) is not None, p
+
+
+def test_top_discoveries_beat_two_x(ranked):
+    assert len(ranked) == 3
+    for seq, ratio in ranked:
+        assert ratio > 2.0  # the r4k+ discoveries, not incumbent-class rows
+
+
+def test_winner_composition_is_the_searched_combination(ranked):
+    """At least one carried discovery uses batched-Pallas unpacks on both
+    z-faces with XLA packs — the context-dependent combination the climb
+    found (no greedy incumbent encodes it, and the isolated microbench even
+    ranks z-unpack kernels the other way)."""
+    found = False
+    for seq, _ in ranked:
+        names = {j.get("name", "") for j in sequence_to_json(seq)}
+        if {"unpack_mz.pallasb", "unpack_pz.pallasb"} <= names and any(
+            n.startswith("pack_") and n.endswith(".xla") for n in names
+        ):
+            found = True
+    assert found
