@@ -40,12 +40,7 @@ import numpy as np
 
 from tenzing_tpu.core.operation import ChoiceOp, OpBase
 from tenzing_tpu.models.halo import HaloArgs, _face_slices, dir_name
-from tenzing_tpu.models.halo_pipeline import (
-    PackFlat,
-    UnpackRecv,
-    flatten_face,
-    unflatten_face,
-)
+from tenzing_tpu.models.halo_pipeline import PackFlat, UnpackRecv
 
 
 def _interpret() -> bool:
@@ -335,8 +330,8 @@ def unpack_face_pallas_batched(
 
 
 class PackPallas(PackFlat):
-    """Pack via the plane-DMA kernel, then flatten to the (rows, 128) staging
-    layout (menu alternative to the XLA slice).
+    """Pack via the window-DMA kernel into the 4D staging buffer (menu
+    alternative to the XLA slice).
 
     INDEX_TIE stays OFF: the Pallas grid needs static start indices, so this
     variant keeps the value-tied read (the executor's default)."""
@@ -352,7 +347,7 @@ class PackPallas(PackFlat):
         out = pack_face_pallas(
             bufs["U"], tuple(starts), tuple(sizes), interpret=_interpret()
         )
-        return {f"buf_{dir_name(self._d)}": flatten_face(out, sizes)}
+        return {f"buf_{dir_name(self._d)}": out}
 
     def uses_pallas(self) -> bool:
         return True
@@ -398,7 +393,7 @@ class PackPallasB(PackFlat):
         out = pack_face_pallas_batched(
             bufs["U"], tuple(starts), tuple(sizes), interpret=_interpret()
         )
-        return {f"buf_{dir_name(self._d)}": flatten_face(out, sizes)}
+        return {f"buf_{dir_name(self._d)}": out}
 
     def uses_pallas(self) -> bool:
         return True
@@ -413,8 +408,7 @@ class UnpackPallas(UnpackRecv):
 
     def apply(self, bufs, ctx):
         starts, _ = _face_slices(self._args, self._d, "unpack")
-        _, sizes = _face_slices(self._args, self._d, "pack")
-        face = unflatten_face(bufs[f"recv_{dir_name(self._d)}"], sizes)
+        face = bufs[f"recv_{dir_name(self._d)}"]
         out = unpack_face_pallas(
             bufs["U"], face, tuple(starts), interpret=_interpret()
         )
@@ -439,8 +433,7 @@ class UnpackPallasB(UnpackRecv):
 
     def apply(self, bufs, ctx):
         starts, _ = _face_slices(self._args, self._d, "unpack")
-        _, sizes = _face_slices(self._args, self._d, "pack")
-        face = unflatten_face(bufs[f"recv_{dir_name(self._d)}"], sizes)
+        face = bufs[f"recv_{dir_name(self._d)}"]
         out = unpack_face_pallas_batched(
             bufs["U"], face, tuple(starts), interpret=_interpret()
         )
