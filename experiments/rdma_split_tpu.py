@@ -39,7 +39,7 @@ def main() -> int:
     # (a) loopback copy split
     @jax.jit
     def loop_split(x):
-        send, recv, y = rdma_start_loopback(x, jnp.zeros_like(x))
+        send, recv, y = rdma_start_loopback(x)
         return rdma_wait_loopback(x, send, recv, y)
 
     y = jax.device_get(loop_split(x))
@@ -49,7 +49,7 @@ def main() -> int:
     # (b) mesh-shift split, size-1 axis (loopback descriptor)
     @jax.jit
     def shift_split(x):
-        send, recv, y = rdma_shift_post(x, jnp.zeros_like(x), (), None, 1)
+        send, recv, y = rdma_shift_post(x, (), None, 1)
         return rdma_shift_wait(x, send, recv, y, (), None, 1)
 
     y = jax.device_get(shift_split(x))

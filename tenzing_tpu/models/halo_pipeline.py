@@ -85,20 +85,14 @@ def unflatten_face(flat, sizes):
 
 
 class PackFlat(Pack):
-    """Pack emitting the 4D face into the staging buffer, layout-preserving.
-
-    Staging buffers are 4D: the searched winner's device trace
-    (experiments/profile_winner.py) showed ~10 ms/iter of XLA chunked
-    layout-conversion copies implementing the old (rows, 128)-flattened
-    staging layout's ``flatten``/``unflatten`` reshapes — a tax the
-    device-resident RDMA engine never needed.  The flat layout exists only
-    because spilling a 4D face with a tiny trailing dim (z-faces are
-    (nq, lx, ly, r)) through pinned-host memory corrupts the round-trip
-    (XLA copies only a partial stripe — a layout bug in mixed-memory copies
-    of oddly-shaped tensors; probed on CPU and v5e), so the flatten now
-    lives INSIDE the host engine (:class:`SpillFlat`/:class:`FetchUnflat`)
-    as its marshalling cost — which is also where the reference pays it
-    (contiguous pack buffers, ops_halo_exchange.hpp:97-186).
+    """Pack that emits the face as a 128-lane-flattened (rows, 128) staging
+    buffer.  Probed on both the CPU backend and TPU v5e: spilling a 4D face
+    with a tiny trailing dim (z-faces are (nq, lx, ly, r)) through
+    pinned-host memory corrupts the round-trip (XLA copies only a partial
+    stripe — a layout bug in mixed-memory copies of oddly-shaped tensors), so
+    every staged transfer uses the 2D tiled layout the host-offload path is
+    reliable for — which is also what the reference does with its staging
+    buffers (contiguous pack buffers, ops_halo_exchange.hpp:97-186).
 
     INDEX_TIE: the op's token dependence rides the slice START index (an
     int32 zero derived from the token, ``ctx.tok_index_zero``) rather than a
@@ -129,51 +123,21 @@ class PackFlat(Pack):
             s + z if i == axis else s for i, s in enumerate(starts)
         )
         sl = lax.dynamic_slice(bufs["U"], starts, sizes)
-        return {f"buf_{dir_name(self._d)}": sl}
+        return {f"buf_{dir_name(self._d)}": flatten_face(sl, sizes)}
 
 
 class UnpackRecv(Unpack):
-    """Unpack reading the round-tripped 4D staging buffer: the ghost-shell
-    write of models/halo.Unpack."""
+    """Unpack reading the fetched (round-tripped) flat staging buffer: reshape
+    back to the face extents, then the same ghost-shell write as
+    models/halo.Unpack."""
 
     def apply(self, bufs, ctx):
         import jax.lax as lax
 
         starts, _ = _face_slices(self._args, self._d, "unpack")
-        face = bufs[f"recv_{dir_name(self._d)}"]
+        _, sizes = _face_slices(self._args, self._d, "pack")
+        face = unflatten_face(bufs[f"recv_{dir_name(self._d)}"], sizes)
         return {"U": lax.dynamic_update_slice(bufs["U"], face, starts)}
-
-
-class SpillFlat(HostSpillStart):
-    """Device->host spill of the 4D face via the (rows, 128) staging layout —
-    the host engine's marshalling cost (see PackFlat docstring: mixed-memory
-    copies of oddly-shaped 4D tensors corrupt; the flat relayout makes the
-    host crossing reliable and is paid only on host-routed faces)."""
-
-    def __init__(self, name: str, src: str, dst: str, sizes):
-        super().__init__(name, src, dst)
-        self._sizes = tuple(sizes)
-
-    def apply(self, bufs, ctx):
-        from tenzing_tpu.ops.comm_ops import _to_memory_kind
-
-        flat = flatten_face(bufs[self._src], self._sizes)
-        return {self._dst: _to_memory_kind(flat, "pinned_host")}
-
-
-class FetchUnflat(HostFetchStart):
-    """Host->device fetch of the flat staging buffer, restored to the 4D
-    face on device (inverse of :class:`SpillFlat`)."""
-
-    def __init__(self, name: str, src: str, dst: str, sizes):
-        super().__init__(name, src, dst)
-        self._sizes = tuple(sizes)
-
-    def apply(self, bufs, ctx):
-        from tenzing_tpu.ops.comm_ops import _to_memory_kind
-
-        dev = _to_memory_kind(bufs[self._src], "device")
-        return {self._dst: unflatten_face(dev, self._sizes)}
 
 
 class HostRoundTrip(CompoundOp):
@@ -182,19 +146,15 @@ class HostRoundTrip(CompoundOp):
     staging analog, packaged so it can sit in a ChoiceOp next to the
     device-resident RDMA alternative."""
 
-    def __init__(self, name: str, dname: str, buf: str, host: str, recv: str,
-                 sizes):
+    def __init__(self, name: str, dname: str, buf: str, host: str, recv: str):
         super().__init__(name)
         self._dname = dname
         self._buf, self._host, self._recv = buf, host, recv
-        self._sizes = tuple(sizes)
 
     def graph(self) -> Graph:
         g = Graph()
-        spill = SpillFlat(f"spill_{self._dname}", self._buf, self._host,
-                          self._sizes)
-        fetch = FetchUnflat(f"fetch_{self._dname}", self._host, self._recv,
-                            self._sizes)
+        spill = HostSpillStart(f"spill_{self._dname}", self._buf, self._host)
+        fetch = HostFetchStart(f"fetch_{self._dname}", self._host, self._recv)
         g.start_then(spill)
         g.then(spill, fetch)
         g.then_finish(fetch)
@@ -208,21 +168,19 @@ class TransferChoice(ChoiceOp):
     CUDA-aware analog — SURVEY §7.0's 'device buffers addressed by ICI DMA').
     Which engine, like which kernel, is the solver's question."""
 
-    def __init__(self, args: HaloArgs, d: Tuple[int, int, int]):
+    def __init__(self, d: Tuple[int, int, int]):
         name = dir_name(d)
         super().__init__(f"xfer_{name}")
-        self._args = args
         self._d = tuple(d)
 
     def choices(self) -> List:
         from tenzing_tpu.ops.rdma import RdmaCopyStart
 
         name = dir_name(self._d)
-        _, sizes = _face_slices(self._args, self._d, "pack")
         return [
             HostRoundTrip(
                 f"xfer_{name}.host", name, f"buf_{name}", f"host_{name}",
-                f"recv_{name}", sizes
+                f"recv_{name}"
             ),
             RdmaCopyStart(f"xfer_{name}.rdma", f"buf_{name}", f"recv_{name}"),
         ]
@@ -257,16 +215,15 @@ def direction_ops(args: HaloArgs, d: Tuple[int, int, int], impl_choice: bool = F
         # and this incumbent seeds directly
         engine = "rdma" if DIRECTIONS.index(tuple(d)) % 2 == 0 else "host"
     if xfer_choice:
-        xfer: Tuple = (TransferChoice(args, d),)
+        xfer: Tuple = (TransferChoice(d),)
     elif engine == "rdma":
         from tenzing_tpu.ops.rdma import RdmaCopyStart
 
         xfer = (RdmaCopyStart(f"xfer_{name}.rdma", f"buf_{name}", f"recv_{name}"),)
     else:
-        _, sizes = _face_slices(args, d, "pack")
         xfer = (
-            SpillFlat(f"spill_{name}", f"buf_{name}", f"host_{name}", sizes),
-            FetchUnflat(f"fetch_{name}", f"host_{name}", f"recv_{name}", sizes),
+            HostSpillStart(f"spill_{name}", f"buf_{name}", f"host_{name}"),
+            HostFetchStart(f"fetch_{name}", f"host_{name}", f"recv_{name}"),
         )
     await_ = AwaitTransfer(f"await_{name}", f"recv_{name}")
     return (pack,) + xfer + (await_, unpack)
@@ -414,11 +371,10 @@ def make_pipeline_buffers(
     for d in DIRECTIONS:
         name = dir_name(d)
         _, sz = _face_slices(args, d, "pack")
-        # device staging stays in the face's 4D layout (no relayout tax on
-        # the RDMA path); only the host crossing is flat (SpillFlat)
-        bufs[f"buf_{name}"] = np.zeros(tuple(sz), dtype=dtype)
-        bufs[f"recv_{name}"] = np.zeros(tuple(sz), dtype=dtype)
-        bufs[f"host_{name}"] = np.zeros((_flat_rows(sz), 128), dtype=dtype)
+        flat = np.zeros((_flat_rows(sz), 128), dtype=dtype)
+        bufs[f"buf_{name}"] = flat
+        bufs[f"host_{name}"] = flat.copy()  # placed in pinned_host by the caller
+        bufs[f"recv_{name}"] = flat.copy()
     return bufs, want
 
 

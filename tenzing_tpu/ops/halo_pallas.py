@@ -40,7 +40,12 @@ import numpy as np
 
 from tenzing_tpu.core.operation import ChoiceOp, OpBase
 from tenzing_tpu.models.halo import HaloArgs, _face_slices, dir_name
-from tenzing_tpu.models.halo_pipeline import PackFlat, UnpackRecv
+from tenzing_tpu.models.halo_pipeline import (
+    PackFlat,
+    UnpackRecv,
+    flatten_face,
+    unflatten_face,
+)
 
 
 def _interpret() -> bool:
@@ -330,8 +335,8 @@ def unpack_face_pallas_batched(
 
 
 class PackPallas(PackFlat):
-    """Pack via the window-DMA kernel into the 4D staging buffer (menu
-    alternative to the XLA slice).
+    """Pack via the plane-DMA kernel, then flatten to the (rows, 128) staging
+    layout (menu alternative to the XLA slice).
 
     INDEX_TIE stays OFF: the Pallas grid needs static start indices, so this
     variant keeps the value-tied read (the executor's default)."""
@@ -347,7 +352,7 @@ class PackPallas(PackFlat):
         out = pack_face_pallas(
             bufs["U"], tuple(starts), tuple(sizes), interpret=_interpret()
         )
-        return {f"buf_{dir_name(self._d)}": out}
+        return {f"buf_{dir_name(self._d)}": flatten_face(out, sizes)}
 
     def uses_pallas(self) -> bool:
         return True
@@ -393,7 +398,7 @@ class PackPallasB(PackFlat):
         out = pack_face_pallas_batched(
             bufs["U"], tuple(starts), tuple(sizes), interpret=_interpret()
         )
-        return {f"buf_{dir_name(self._d)}": out}
+        return {f"buf_{dir_name(self._d)}": flatten_face(out, sizes)}
 
     def uses_pallas(self) -> bool:
         return True
@@ -408,7 +413,8 @@ class UnpackPallas(UnpackRecv):
 
     def apply(self, bufs, ctx):
         starts, _ = _face_slices(self._args, self._d, "unpack")
-        face = bufs[f"recv_{dir_name(self._d)}"]
+        _, sizes = _face_slices(self._args, self._d, "pack")
+        face = unflatten_face(bufs[f"recv_{dir_name(self._d)}"], sizes)
         out = unpack_face_pallas(
             bufs["U"], face, tuple(starts), interpret=_interpret()
         )
@@ -433,7 +439,8 @@ class UnpackPallasB(UnpackRecv):
 
     def apply(self, bufs, ctx):
         starts, _ = _face_slices(self._args, self._d, "unpack")
-        face = bufs[f"recv_{dir_name(self._d)}"]
+        _, sizes = _face_slices(self._args, self._d, "pack")
+        face = unflatten_face(bufs[f"recv_{dir_name(self._d)}"], sizes)
         out = unpack_face_pallas_batched(
             bufs["U"], face, tuple(starts), interpret=_interpret()
         )

@@ -158,15 +158,9 @@ def rdma_copy_fused_local(x: jax.Array, interpret: Optional[bool] = None) -> jax
 # -- split start/wait (TPU hardware): semaphores as kernel outputs ----------
 
 
-def _shift_post_kernel(axes, axis, shift, x_ref, y0_ref, send_ref, recv_ref,
-                       y_ref):
+def _shift_post_kernel(axes, axis, shift, x_ref, send_ref, recv_ref, y_ref):
     """Post half of the mesh neighbor shift: neighbor barrier, then
-    ``rdma.start()`` — returns with the DMA in flight (MPI_Isend).  The
-    destination is the caller's pre-allocated recv buffer passed through
-    aliased (``y0_ref`` -> ``y_ref``): a fresh pallas output forced XLA to
-    materialize defensive copies of the staging buffers around the custom
-    call every iteration (measured in the winner's device trace —
-    experiments/profile_winner.py)."""
+    ``rdma.start()`` — returns with the DMA in flight (MPI_Isend)."""
     fwd, bwd, id_type, n = _mesh_ids(axes, axis, shift)
     if n > 1:
         barrier = pltpu.get_barrier_semaphore()
@@ -193,14 +187,12 @@ def _shift_wait_kernel(axes, axis, shift, x_ref, send_ref, recv_ref, y_in_ref, y
 
 def rdma_shift_post(
     x: jax.Array,
-    y0: jax.Array,
     axes: Tuple[str, ...],
     axis: Optional[str],
     shift: int,
     collective_id: int = 0,
 ):
-    """Post the mesh neighbor shift into the pre-allocated destination
-    ``y0`` (aliased through); returns (send_sem, recv_sem, y) with the
+    """Post the mesh neighbor shift; returns (send_sem, recv_sem, y) with the
     remote DMA in flight — the MPI_Isend half of the reference's split
     (ops_mpi.hpp:17-146).  TPU only: the interpreter cannot materialize
     semaphore outputs (probed on v5e; see module docstring)."""
@@ -213,10 +205,7 @@ def rdma_shift_post(
     )
     return pl.pallas_call(
         kern,
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=(
             pl.BlockSpec(memory_space=pltpu.SEMAPHORE),
             pl.BlockSpec(memory_space=pltpu.SEMAPHORE),
@@ -227,10 +216,9 @@ def rdma_shift_post(
             pltpu.SemaphoreType.DMA(()),
             jax.ShapeDtypeStruct(x.shape, x.dtype),
         ),
-        input_output_aliases={1: 2},
         compiler_params=params,
         name="rdma_shift_post",
-    )(x, y0)
+    )(x)
 
 
 def rdma_shift_wait(
@@ -256,36 +244,18 @@ def rdma_shift_wait(
     )(x, send, recv, y)
 
 
-def rdma_start_loopback(x: jax.Array, y0: jax.Array):
-    """Post a device->device RDMA copy of ``x`` into the pre-allocated
-    ``y0`` (aliased); returns (send_sem, recv_sem, y) with the DMA in flight
-    — the MPI_Isend half.  TPU only (the interpreter cannot materialize
-    semaphore outputs; probed).  The degenerate no-axis shift: ``_mesh_ids``
-    yields the LOGICAL self-descriptor and no barrier."""
-    return rdma_shift_post(x, y0, (), None, 1)
+def rdma_start_loopback(x: jax.Array):
+    """Post a device->device RDMA copy of ``x``; returns (send_sem, recv_sem,
+    y) with the DMA in flight — the MPI_Isend half.  TPU only (the interpreter
+    cannot materialize semaphore outputs; probed).  The degenerate no-axis
+    shift: ``_mesh_ids`` yields the LOGICAL self-descriptor and no barrier."""
+    return rdma_shift_post(x, (), None, 1)
 
 
 def rdma_wait_loopback(x: jax.Array, send, recv, y: jax.Array) -> jax.Array:
     """Block on the in-flight copy's semaphores and return the completed
     destination (aliased, no extra copy) — the MPI_Wait half."""
     return rdma_shift_wait(x, send, recv, y, (), None, 1)
-
-
-def _alias_dest(bufs: Dict[str, Any], dst: str, x):
-    """The model's pre-allocated recv buffer to alias through the post kernel
-    (a fresh pallas output forced XLA to materialize defensive staging copies
-    — profile_winner.py); falls back to a fresh zeros buffer when the name is
-    unallocated or mismatched in shape/dtype."""
-    import jax.numpy as jnp
-
-    y0 = bufs.get(dst)
-    if (
-        y0 is None
-        or getattr(y0, "shape", None) != x.shape
-        or getattr(y0, "dtype", None) != x.dtype
-    ):
-        y0 = jnp.zeros_like(x)
-    return y0
 
 
 # -- schedulable ops --------------------------------------------------------
@@ -306,7 +276,7 @@ class RdmaCopyStart(CommStart):
         x = bufs[self._src]
         if _interpret():
             return {self._dst: rdma_copy_fused_local(x)}
-        send, recv, y = rdma_start_loopback(x, _alias_dest(bufs, self._dst, x))
+        send, recv, y = rdma_start_loopback(x)
         inflight = getattr(ctx, "inflight", None)
         if inflight is not None:
             inflight[self._dst] = functools.partial(
@@ -353,8 +323,7 @@ class RdmaShiftStart(CommStart):
                 )
             }
         send, recv, y = rdma_shift_post(
-            x, _alias_dest(bufs, self._dst, x), axes, axis, self._shift,
-            collective_id=self._cid,
+            x, axes, axis, self._shift, collective_id=self._cid
         )
         inflight = getattr(ctx, "inflight", None)
         if inflight is not None:
