@@ -18,8 +18,12 @@ Workloads (``--workload``):
   expert-kernel (XLA vs Pallas) across independent microbatch chunk chains.
 
 The search is anytime: greedy domain incumbents (for halo, an engine x
-lane-count grid) seed an MCTS (FastMin) that explores at CHEAP measurement
-cost — search-time numbers only steer the tree.  Candidate selection and the
+lane-count grid), the best recorded schedules from previous runs' databases
+(``--seed-csv``, bench/recorded.py — cross-run search memory ranked by
+in-file paired ratio), and a FastMin MCTS that explores at CHEAP measurement
+cost — search-time numbers only steer the tree — followed by drift-immune
+hill-climbs seeded from the best recorded schedule's menu choices and from
+the strongest hand disciplines.  Candidate selection and the
 verdict are both *paired decorrelated batches* (reference batch benchmark,
 benchmarker.cpp:21-76): a moderate-cost screen ranks the distinct candidates
 by paired per-iteration speedup vs naive and drops anything below 1.0, then

@@ -1,0 +1,124 @@
+"""Profile the flagship winner: where do 12.5 ms go when the menu-additive
+floor is 3.4 ms?
+
+Finding + measured follow-up (round 4): the trace attributes ~10 ms/iter of
+busy time to XLA chunked layout-conversion copies (slice-start/copy-start
+families through S(1)) implementing the (rows,128) <-> 4D-face reshapes of
+the flat staging layout.  A 4D-staging-end-to-end rework (commit "4D staging
+end-to-end", reverted) removed the reshapes — and made the searched winner
+SLOWER (driver r4p: winner 15.3 ms vs the 12.2-12.6 ms flat-staging basin;
+verdict 2.19 vs 2.45-2.59): 4D staging buffers are tile-padded, so y/z faces
+(3-wide in a sublane/lane dim padded to 8/128) carry 2.7-42x more DMA bytes
+per transfer than the dense flat layout.  The relayout tax is OVERLAPPABLE
+(the searched schedules hide it behind transfers); the padded-DMA tax is
+not.  Dense-but-reshape-free staging would need pack kernels that emit the
+(rows,128) layout directly from the grid window (an in-kernel cross-lane
+relayout Mosaic does not currently express cheaply) — recorded here as the
+next kernel-level headroom, with the flat layout kept as the measured
+winner.
+
+Loads the best recorded schedule from the round-4 databases
+(bench/recorded.py ranking), traces it with jax.profiler through the real
+executor, and reports the device-timeline breakdown: per-op-name busy time,
+transfer/compute concurrency, and the top time sinks.  Companion to
+halo_roofline.py's bounds — this attributes the gap instead of just
+measuring it.
+
+Run on the TPU: python experiments/profile_winner.py
+Writes experiments/PROFILE_WINNER.json (+ raw trace under experiments/traces/).
+"""
+
+import glob
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def per_op_breakdown(trace_dir, top_n: int = 24):
+    """Total busy ns per event name on device planes, longest first."""
+    from jax.profiler import ProfileData
+
+    paths = sorted(glob.glob(str(Path(trace_dir) / "**" / "*.xplane.pb"),
+                             recursive=True))
+    data = ProfileData.from_file(paths[-1])
+    busy = defaultdict(float)
+    spans = defaultdict(int)
+    for plane in data.planes:
+        pname = plane.name.lower()
+        if not ("tpu" in pname or "device" in pname or "xla" in pname):
+            continue
+        for line in plane.lines:
+            for ev in line.events:
+                if ev.end_ns > ev.start_ns:
+                    busy[ev.name] += (ev.end_ns - ev.start_ns) / 1e6
+                    spans[ev.name] += 1
+    rows = sorted(busy.items(), key=lambda kv: -kv[1])[:top_n]
+    return [{"name": n, "total_ms": round(t, 3), "events": spans[n]}
+            for n, t in rows]
+
+
+def main() -> int:
+    from tenzing_tpu.bench.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    from tenzing_tpu.bench.recorded import rank_recorded
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.models.halo_pipeline import (
+        build_graph,
+        host_buffer_names,
+        make_pipeline_buffers,
+        naive_order,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.utils.profiling import analyze_trace, capture_trace
+
+    hargs = HaloArgs(nq=3, lx=512, ly=512, lz=512, radius=3)
+    g = build_graph(hargs, impl_choice=True, xfer_choice=True)
+    repo = Path(__file__).resolve().parent.parent
+    paths = sorted(glob.glob(str(repo / "experiments" /
+                                 "halo_search_tpu_r4*.csv")))
+    ranked = rank_recorded(paths, g, topk=1,
+                           log=lambda m: sys.stderr.write(m + "\n"))
+    assert ranked, "no recorded winner to profile"
+    winner, ratio = ranked[0]
+
+    bufs, _ = make_pipeline_buffers(hargs, seed=0, with_expected=False)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    plat = Platform.make_n_lanes(8)
+    ex = TraceExecutor(plat, jbufs)
+
+    out = {"recorded_ratio": round(ratio, 4), "schedules": {}}
+    tdir = repo / "experiments" / "traces"
+    for label, seq in (
+        ("winner", winner),
+        ("naive", naive_order(hargs, Platform.make_n_lanes(1))),
+    ):
+        d = tdir / f"profile_{label}"
+        _, wall = capture_trace(ex, seq, d, iters=3)
+        conc = analyze_trace(d)
+        ops = per_op_breakdown(d)
+        out["schedules"][label] = {
+            "wall_s_3iters": round(wall, 4),
+            "concurrency": conc,
+            "top_ops": ops,
+        }
+        sys.stderr.write(f"{label}: wall {wall:.3f}s\n")
+        for r in ops[:12]:
+            sys.stderr.write(
+                f"  {r['total_ms']:9.3f} ms x{r['events']:<4} {r['name'][:90]}\n"
+            )
+
+    (repo / "experiments" / "PROFILE_WINNER.json").write_text(
+        json.dumps(out, indent=1)
+    )
+    print("wrote experiments/PROFILE_WINNER.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
