@@ -331,6 +331,181 @@ def unpack_face_pallas_batched(
     )(u, face)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("starts", "sizes", "interpret")
+)
+def pack_face_flat_pallas(
+    u: jax.Array, starts: Tuple[int, ...], sizes: Tuple[int, ...],
+    interpret: bool = False
+) -> jax.Array:
+    """Batched-row pack emitting the dense (rows, 128) STAGING layout
+    directly: the face rows are extracted from the aligned window in VMEM and
+    relaid to the flat layout with an in-kernel reshape (vreg shuffles at
+    VMEM bandwidth), so the separate XLA flatten pass — measured at
+    ~10 ms/iter of chunked HBM relayout copies across the winner's schedule
+    (experiments/profile_winner.py) — disappears, while the staging buffer
+    stays dense (the 4D-staging A/B showed tile-padded staging pays 2.7x+
+    DMA bytes).  Requires sz % 128 == 0 (the ``_flat_ok`` gate): that keeps
+    every (BX, sy, sz) block row-aligned in the flat buffer AND the relayout
+    a sublane merge Mosaic can lower — z-faces (sz = radius) fail the Mosaic
+    relayout pass, probed on v5e.  NOTE: the two-slot DMA choreography here
+    (t==0 bootstrap, t+1 prefetch, slot-b drain) is intentionally identical
+    to pack_face_pallas_batched's — fix bugs in BOTH (and in the two unpack
+    twins)."""
+    nq, sx, sy, sz = sizes
+    _, x0, y0, z0 = starts
+    _, _, Y, Z = u.shape
+    assert sz % 128 == 0, (sy, sz)  # _flat_ok gate
+    wy0, WH, wz0, WW = _tile_window(y0, sy, z0, sz, Y, Z, u.dtype.itemsize)
+    BX = _batch_rows(sx, WH * WW * u.dtype.itemsize)
+    nb = sx // BX
+    total = nq * nb
+    br = (BX * sy * sz) // 128  # flat rows per block
+    yl, zl = y0 - wy0, z0 - wz0
+
+    def kernel(u_ref, o_ref, win0, win1, s0, s1):
+        q = pl.program_id(0)
+        b = pl.program_id(1)
+        t = q * nb + b
+
+        def u_slice(tt):
+            qq = tt // nb
+            bb = tt - qq * nb
+            return u_ref.at[
+                qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH), pl.ds(wz0, WW)
+            ]
+
+        def body(wa, sa, wb, sb):
+            @pl.when(t == 0)
+            def _():
+                pltpu.make_async_copy(u_slice(t), wa, sa).start()
+
+            pltpu.make_async_copy(u_slice(t), wa, sa).wait()
+
+            @pl.when(t + 1 < total)
+            def _():
+                pltpu.make_async_copy(u_slice(t + 1), wb, sb).start()
+
+            o_ref[...] = wa[:, yl : yl + sy, zl : zl + sz].reshape(br, 128)
+
+        @pl.when(t % 2 == 0)
+        def _():
+            body(win0, s0, win1, s1)
+
+        @pl.when(t % 2 == 1)
+        def _():
+            body(win1, s1, win0, s0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nq, nb),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((br, 128), lambda q, b: (q * nb + b, 0)),
+        out_shape=jax.ShapeDtypeStruct((nq * sx * sy * sz // 128, 128),
+                                       u.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BX, WH, WW), u.dtype),
+            pltpu.VMEM((BX, WH, WW), u.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(u)
+
+
+@functools.partial(jax.jit, static_argnames=("starts", "sizes", "interpret"))
+def unpack_face_flat_pallas(
+    u: jax.Array, flat: jax.Array, starts: Tuple[int, ...],
+    sizes: Tuple[int, ...], interpret: bool = False
+) -> jax.Array:
+    """Batched-row unpack consuming the dense (rows, 128) staging buffer
+    directly (inverse of :func:`pack_face_flat_pallas`): each flat block is
+    relaid to face rows in VMEM and merged into the aligned window, with the
+    same two-slot fetch/write-back pipeline and final drain as the batched
+    window kernel.  Aliased in place."""
+    nq, sx, sy, sz = sizes
+    _, x0, y0, z0 = starts
+    _, _, Y, Z = u.shape
+    assert sz % 128 == 0, (sy, sz)  # _flat_ok gate
+    wy0, WH, wz0, WW = _tile_window(y0, sy, z0, sz, Y, Z, u.dtype.itemsize)
+    BX = _batch_rows(sx, WH * WW * u.dtype.itemsize)
+    nb = sx // BX
+    total = nq * nb
+    br = (BX * sy * sz) // 128
+    yl, zl = y0 - wy0, z0 - wz0
+
+    def kernel(u_ref, f_ref, o_ref, win0, win1, s0i, s1i, s0o, s1o):
+        q = pl.program_id(0)
+        b = pl.program_id(1)
+        t = q * nb + b
+
+        def u_slice(ref, tt):
+            qq = tt // nb
+            bb = tt - qq * nb
+            return ref.at[
+                qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH), pl.ds(wz0, WW)
+            ]
+
+        def body(wa, sai, sao, wb, sbi, sbo):
+            @pl.when(t == 0)
+            def _():
+                pltpu.make_async_copy(u_slice(u_ref, t), wa, sai).start()
+
+            pltpu.make_async_copy(u_slice(u_ref, t), wa, sai).wait()
+
+            @pl.when(t + 1 < total)
+            def _():
+                @pl.when(t >= 1)
+                def _():
+                    pltpu.make_async_copy(
+                        wb, u_slice(o_ref, t - 1), sbo
+                    ).wait()
+
+                pltpu.make_async_copy(u_slice(u_ref, t + 1), wb, sbi).start()
+
+            wa[:, yl : yl + sy, zl : zl + sz] = f_ref[...].reshape(BX, sy, sz)
+            pltpu.make_async_copy(wa, u_slice(o_ref, t), sao).start()
+
+            @pl.when(t == total - 1)
+            def _():
+                @pl.when(t >= 1)
+                def _():
+                    pltpu.make_async_copy(
+                        wb, u_slice(o_ref, t - 1), sbo
+                    ).wait()
+
+                pltpu.make_async_copy(wa, u_slice(o_ref, t), sao).wait()
+
+        @pl.when(t % 2 == 0)
+        def _():
+            body(win0, s0i, s0o, win1, s1i, s1o)
+
+        @pl.when(t % 2 == 1)
+        def _():
+            body(win1, s1i, s1o, win0, s0i, s0o)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nq, nb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((br, 128), lambda q, b: (q * nb + b, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(u.shape, u.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BX, WH, WW), u.dtype),
+            pltpu.VMEM((BX, WH, WW), u.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(u, flat)
+
+
 # -- ops + choice menu ------------------------------------------------------------
 
 
@@ -424,10 +599,62 @@ class UnpackPallas(UnpackRecv):
         return True
 
 
+def _flat_ok(args: HaloArgs, d) -> bool:
+    """Whether the direct-flat kernels apply: the face's trailing dim must be
+    lane-aligned (sz % 128 == 0) — that makes every block row-aligned in the
+    (rows, 128) staging buffer AND keeps the in-kernel relayout a
+    sublane-merge Mosaic can lower (probed on v5e: a 3-wide trailing dim —
+    z-faces — fails in the Mosaic relayout pass)."""
+    _, sizes = _face_slices(args, d, "pack")
+    return sizes[3] % 128 == 0
+
+
+class PackPallasF(PackFlat):
+    """Pack via the direct-flat kernel: dense staging emitted straight from
+    the grid window, relayout in VMEM (no separate XLA flatten pass)."""
+
+    INDEX_TIE = False
+
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(args, d)
+        self._name = f"pack_{dir_name(d)}.pallasf"
+
+    def apply(self, bufs, ctx):
+        starts, sizes = _face_slices(self._args, self._d, "pack")
+        out = pack_face_flat_pallas(
+            bufs["U"], tuple(starts), tuple(sizes), interpret=_interpret()
+        )
+        return {f"buf_{dir_name(self._d)}": out}
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
 class UnpackXla(UnpackRecv):
     def __init__(self, args: HaloArgs, d):
         super().__init__(args, d)
         self._name = f"unpack_{dir_name(d)}.xla"
+
+
+class UnpackPallasF(UnpackRecv):
+    """Unpack via the direct-flat kernel (consumes the dense staging buffer
+    with no separate XLA unflatten pass; aliased in place)."""
+
+    def __init__(self, args: HaloArgs, d):
+        super().__init__(args, d)
+        self._name = f"unpack_{dir_name(d)}.pallasf"
+
+    def apply(self, bufs, ctx):
+        starts, _ = _face_slices(self._args, self._d, "unpack")
+        _, sizes = _face_slices(self._args, self._d, "pack")
+        out = unpack_face_flat_pallas(
+            bufs["U"], bufs[f"recv_{dir_name(self._d)}"], tuple(starts),
+            tuple(sizes), interpret=_interpret()
+        )
+        return {"U": out}
+
+    def uses_pallas(self) -> bool:
+        return True
 
 
 class UnpackPallasB(UnpackRecv):
@@ -464,6 +691,8 @@ class PackChoice(ChoiceOp):
         ]
         if _face_bx(self._args, self._d) > 1:
             menu.append(PackPallasB(self._args, self._d))
+        if _flat_ok(self._args, self._d):
+            menu.append(PackPallasF(self._args, self._d))
         return menu
 
 
@@ -478,4 +707,6 @@ class UnpackChoice(ChoiceOp):
         ]
         if _face_bx(self._args, self._d, which="unpack") > 1:
             menu.append(UnpackPallasB(self._args, self._d))
+        if _flat_ok(self._args, self._d):
+            menu.append(UnpackPallasF(self._args, self._d))
         return menu

@@ -173,6 +173,63 @@ def test_batched_pallas_multi_block_pipeline():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want))
 
 
+def test_flat_pallas_kernels_match_reference():
+    """Direct-flat kernels (dense staging emitted/consumed with the relayout
+    in VMEM) == XLA slice+flatten / unflatten+DUS on every lane-aligned
+    face."""
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.models.halo_pipeline import flatten_face
+    from tenzing_tpu.ops.halo_pallas import (
+        _flat_ok,
+        pack_face_flat_pallas,
+        unpack_face_flat_pallas,
+    )
+
+    from tenzing_tpu.models.halo_pipeline import _padded_shape
+
+    args = HaloArgs(nq=1, lx=8, ly=64, lz=128, radius=2)
+    rng = np.random.default_rng(7)
+    pad = _padded_shape(args.local_shape())
+    u = jnp.asarray(rng.random(pad, dtype=np.float32))
+    covered = 0
+    for d in DIRECTIONS:
+        if not _flat_ok(args, d):
+            continue
+        covered += 1
+        ps, sz = _face_slices(args, d, "pack")
+        us, _ = _face_slices(args, d, "unpack")
+        want = flatten_face(jax.lax.dynamic_slice(u, ps, sz), sz)
+        got = pack_face_flat_pallas(u, tuple(ps), tuple(sz), interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+        flat = jnp.asarray(rng.random(want.shape, dtype=np.float32))
+        wantu = jax.lax.dynamic_update_slice(u, flat.reshape(tuple(sz)), us)
+        gotu = unpack_face_flat_pallas(u, flat, tuple(us), tuple(sz),
+                                       interpret=True)
+        np.testing.assert_allclose(np.asarray(gotu), np.asarray(wantu))
+    assert covered >= 4  # x and y faces; z excluded by the lane gate
+
+
+def test_flat_gate_excludes_lane_thin_faces():
+    """z-faces (trailing dim = radius) fail the sz % 128 gate — Mosaic cannot
+    lower the sub-lane-width relayout (probed on v5e) — and stay off the
+    flat menu while x/y faces at the flagship geometry get the extra
+    entry."""
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.ops.halo_pallas import PackChoice, UnpackChoice, _flat_ok
+
+    args = HaloArgs(nq=3, lx=512, ly=512, lz=512, radius=3)
+    assert _flat_ok(args, (1, 0, 0)) and _flat_ok(args, (0, 1, 0))
+    assert not _flat_ok(args, (0, 0, 1))
+    assert any(
+        c.name().endswith(".pallasf")
+        for c in UnpackChoice(args, (0, 1, 0)).choices()
+    )
+    assert not any(
+        c.name().endswith(".pallasf")
+        for c in PackChoice(args, (0, 0, 1)).choices()
+    )
+
+
 def test_batched_variant_on_menu_only_when_it_differs():
     """At the flagship geometry y/z faces batch >1 row per DMA, so the menu
     grows to 3; x-faces degenerate to the per-row kernel (BX=1) and stay
@@ -184,8 +241,10 @@ def test_batched_variant_on_menu_only_when_it_differs():
     assert _face_bx(args, (1, 0, 0)) == 1
     assert _face_bx(args, (0, 1, 0)) > 1
     assert _face_bx(args, (0, 0, 1)) > 1
-    assert len(PackChoice(args, (1, 0, 0)).choices()) == 2
-    assert len(PackChoice(args, (0, 1, 0)).choices()) == 3
+    # x: xla + pallas + pallasf (bx=1 keeps pallasb off); y: all four;
+    # z: xla + pallas + pallasb (lane gate keeps pallasf off)
+    assert len(PackChoice(args, (1, 0, 0)).choices()) == 3
+    assert len(PackChoice(args, (0, 1, 0)).choices()) == 4
     assert len(UnpackChoice(args, (0, 0, 1)).choices()) == 3
 
 
