@@ -795,12 +795,20 @@ def main() -> int:
             # >= 1.5x) — honest no-win workloads (SpMV ~1.0 everywhere)
             # never trip it.  One re-run, then the measurement stands.
             predicted = naive.pct50 / min(s.result.pct50 for s in cands)
-            degenerate = max(p[0] for p in screen) < 1.1 and predicted > 1.5
+            best_screen = max(p[0] for p in screen)
+            # second clause added after r4w: a degraded chip regime flattened
+            # the whole screen to 1.02-1.18 while the search predicted 3.4x
+            # (the high-floor final then measured the survivors at 2.39x —
+            # but the RANKING had already been made under the flattened
+            # regime, advancing a 1.30 incumbent over stronger climbs)
+            degenerate = (best_screen < 1.1 and predicted > 1.5) or (
+                best_screen < 1.25 and predicted > 1.8
+            )
             if not degenerate or attempt == 1:
                 break
             sys.stderr.write(
-                "screen degenerate (all ratios ~1.0, search predicted "
-                f"{predicted:.2f}x) — re-running once\n"
+                f"screen degenerate (best ratio {best_screen:.2f}, search "
+                f"predicted {predicted:.2f}x) — re-running once\n"
             )
         ranked = sorted(
             zip(cands, screen), key=lambda sp: sp[1][0], reverse=True
