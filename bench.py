@@ -483,6 +483,7 @@ def main() -> int:
         for ri, (seq_r, ratio) in enumerate(picked):
             t0 = time.time()
             meas = None
+            err = None
             for attempt in (0, 1):  # one retry: the tunnel has flaky spells
                 try:
                     meas = bench.benchmark(seq_r, search_opts)
@@ -492,7 +493,8 @@ def main() -> int:
             if meas is None:
                 sys.stderr.write(
                     f"recorded[{ri}] dropped after retry "
-                    f"({type(err).__name__}: {str(err)[:200]})\n"
+                    f"({type(err).__name__ if err else 'unknown'}:"
+                    f" {str(err)[:200]})\n"
                 )
                 continue
             sys.stderr.write(

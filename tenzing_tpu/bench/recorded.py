@@ -17,6 +17,7 @@ discoveries worth carrying (observed: the r4k 2.48x winner recorded in a
 from typing import List, Optional, Tuple
 
 from tenzing_tpu.bench.benchmarker import CSV_DELIM, CsvBenchmarker
+from tenzing_tpu.core.schedule import remove_redundant_syncs
 from tenzing_tpu.core.sequence import Sequence, canonical_key
 
 
@@ -66,7 +67,10 @@ def rank_recorded(
     for ratio, seq in scored:
         if len(out) >= topk:
             break
-        key = canonical_key(seq)
+        # dedup modulo redundant syncs — the same equivalence CsvBenchmarker
+        # matches on (normalize=True), so a DFS-dumped and an MCTS-cleaned
+        # copy of one program don't burn two warm-start slots
+        key = canonical_key(remove_redundant_syncs(seq))
         if key in seen:
             continue
         seen.add(key)

@@ -41,24 +41,32 @@ def sequence_to_json_str(seq: Sequence) -> str:
     return json.dumps(sequence_to_json(seq))
 
 
+def _search_op(op: OpBase, name: str) -> Optional[OpBase]:
+    """Uniform recursive match on one op: its own name, then — whatever the
+    nesting — compound sub-graphs and choice alternatives (reference
+    operation_serdes.cpp:14-56 recurses uniformly; a ChoiceOp nested inside a
+    choice alternative's compound must resolve the same as a top-level one)."""
+    if op.name() == name:
+        return op
+    if isinstance(op, CompoundOp):
+        hit = _find_by_name(op.graph(), name)
+        if hit is not None:
+            return hit
+    if isinstance(op, ChoiceOp):
+        for c in op.choices():
+            hit = _search_op(c, name)
+            if hit is not None:
+                return hit
+    return None
+
+
 def _find_by_name(graph: Graph, name: str) -> Optional[OpBase]:
     """Recursive graph-anchored lookup (reference operation_serdes.cpp:14-56):
     search vertices, descending into compound sub-graphs and choice alternatives."""
     for v in graph.vertices():
-        if v.name() == name:
-            return v
-        if isinstance(v, CompoundOp):
-            hit = _find_by_name(v.graph(), name)
-            if hit is not None:
-                return hit
-        if isinstance(v, ChoiceOp):
-            for c in v.choices():
-                if c.name() == name:
-                    return c
-                if isinstance(c, CompoundOp):
-                    hit = _find_by_name(c.graph(), name)
-                    if hit is not None:
-                        return hit
+        hit = _search_op(v, name)
+        if hit is not None:
+            return hit
     return None
 
 

@@ -70,10 +70,16 @@ class HaloArgs:
     ly: int = 64
     lz: int = 64
     radius: int = 3
+    # grid element dtype, as a string so the dataclass stays hashable (the
+    # sublane tile — and with it the Pallas menu gating — depends on itemsize)
+    dtype: str = "float32"
 
     def local_shape(self) -> Tuple[int, int, int, int]:
         r = self.radius
         return (self.nq, self.lx + 2 * r, self.ly + 2 * r, self.lz + 2 * r)
+
+    def itemsize(self) -> int:
+        return np.dtype(self.dtype).itemsize
 
 
 def _face_slices(args: HaloArgs, d: Tuple[int, int, int], which: str):

@@ -334,27 +334,34 @@ def paired_overlap_order(args: HaloArgs, platform, engine: str = "mixed") -> Seq
     return seq
 
 
-def _padded_shape(shape: Tuple[int, int, int, int]) -> Tuple[int, int, int, int]:
-    """U allocated with trailing dims padded to TPU tiling (8 sublanes x 128
-    lanes): Mosaic requires HBM plane DMAs tile-aligned (ops/halo_pallas.py),
-    and the padding is invisible to the XLA slice path (all face slices are
-    interior)."""
+def _padded_shape(shape: Tuple[int, int, int, int],
+                  itemsize: int = 4) -> Tuple[int, int, int, int]:
+    """U allocated with trailing dims padded to TPU tiling (sublane tile x
+    128 lanes; the sublane tile scales with dtype width — 8 for 4-byte, 16
+    for 2-byte, 32 for 1-byte): Mosaic requires HBM plane DMAs tile-aligned
+    (ops/halo_pallas.py), and the padding is invisible to the XLA slice path
+    (all face slices are interior)."""
     nq, x, y, z = shape
-    return (nq, x, -(-y // 8) * 8, -(-z // 128) * 128)
+    st = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    return (nq, x, -(-y // st) * st, -(-z // 128) * 128)
 
 
 def make_pipeline_buffers(
-    args: HaloArgs, seed: int = 0, dtype=np.float32, with_expected: bool = True
+    args: HaloArgs, seed: int = 0, with_expected: bool = True
 ) -> Tuple[Dict[str, np.ndarray], Optional[np.ndarray]]:
     """(buffers, expected U): ghost shells filled with the shard's own opposite
     interior faces (periodic 1-shard domain).  ``with_expected=False`` skips
-    the expected-U copy (a ~2 GB allocation at the reference bench config)."""
+    the expected-U copy (a ~2 GB allocation at the reference bench config).
+    The grid dtype is ``args.dtype`` — one source of truth shared with the
+    Pallas menu gate (ops/halo_pallas.py ``_face_bx``)."""
     r = args.radius
+    dtype = np.dtype(args.dtype)
     rng = np.random.default_rng(seed)
-    U = np.zeros(_padded_shape(args.local_shape()), dtype=dtype)
+    U = np.zeros(_padded_shape(args.local_shape(), dtype.itemsize),
+                 dtype=dtype)
     U[:, r : r + args.lx, r : r + args.ly, r : r + args.lz] = rng.random(
         (args.nq, args.lx, args.ly, args.lz), dtype=np.float32
-    ).astype(dtype)
+    ).astype(dtype, copy=False)
     want = None
     if with_expected:
         want = U.copy()

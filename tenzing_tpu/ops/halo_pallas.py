@@ -52,6 +52,91 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# The two-slot rotating-DMA kernels assume the grid executes strictly
+# sequentially in linear order t = q*nb + b.  That is Pallas TPU's default
+# today, but nothing else pins it — "arbitrary" makes the requirement
+# explicit so a future parallel/megacore grid default can't silently race
+# the rotating slots.
+_SEQUENTIAL_GRID = pltpu.CompilerParams(
+    dimension_semantics=("arbitrary", "arbitrary")
+)
+
+
+def _two_slot_fetch(t, total, src_slice, slots, sems, emit):
+    """The read-side two-slot choreography shared by both batched pack
+    kernels: bootstrap the t==0 fetch, await this step's window, prefetch
+    t+1 into the other slot, then run ``emit(window)`` on the landed rows.
+    One definition so a fix lands in every user (ADVICE r4: the pattern was
+    hand-duplicated across four kernels)."""
+
+    def body(wa, sa, wb, sb):
+        @pl.when(t == 0)
+        def _():
+            pltpu.make_async_copy(src_slice(t), wa, sa).start()
+
+        pltpu.make_async_copy(src_slice(t), wa, sa).wait()
+
+        @pl.when(t + 1 < total)
+        def _():
+            pltpu.make_async_copy(src_slice(t + 1), wb, sb).start()
+
+        emit(wa)
+
+    @pl.when(t % 2 == 0)
+    def _():
+        body(slots[0], sems[0], slots[1], sems[1])
+
+    @pl.when(t % 2 == 1)
+    def _():
+        body(slots[1], sems[1], slots[0], sems[0])
+
+
+def _two_slot_rmw(t, total, in_slice, out_slice, slots, in_sems, out_sems,
+                  merge):
+    """The read-modify-write two-slot choreography shared by both batched
+    unpack kernels: fetch the step-t window (bootstrapped at t==0), drain the
+    other slot's t-1 write-back before reusing it for the t+1 prefetch (the
+    fetch reads disjoint rows, so the two DMAs fly together), run
+    ``merge(window)``, post the write-back, and drain BOTH slots on the
+    final step (the last write-back is never waited by a next prefetch)."""
+
+    def body(wa, sai, sao, wb, sbi, sbo):
+        @pl.when(t == 0)
+        def _():
+            pltpu.make_async_copy(in_slice(t), wa, sai).start()
+
+        pltpu.make_async_copy(in_slice(t), wa, sai).wait()
+
+        @pl.when(t + 1 < total)
+        def _():
+            @pl.when(t >= 1)
+            def _():
+                pltpu.make_async_copy(wb, out_slice(t - 1), sbo).wait()
+
+            pltpu.make_async_copy(in_slice(t + 1), wb, sbi).start()
+
+        merge(wa)
+        pltpu.make_async_copy(wa, out_slice(t), sao).start()
+
+        @pl.when(t == total - 1)
+        def _():
+            @pl.when(t >= 1)
+            def _():
+                pltpu.make_async_copy(wb, out_slice(t - 1), sbo).wait()
+
+            pltpu.make_async_copy(wa, out_slice(t), sao).wait()
+
+    @pl.when(t % 2 == 0)
+    def _():
+        body(slots[0], in_sems[0], out_sems[0], slots[1], in_sems[1],
+             out_sems[1])
+
+    @pl.when(t % 2 == 1)
+    def _():
+        body(slots[1], in_sems[1], out_sems[1], slots[0], in_sems[0],
+             out_sems[0])
+
+
 def _tile_window(y0: int, sy: int, z0: int, sz: int,
                  Y: int, Z: int, itemsize: int = 4) -> Tuple[int, int, int, int]:
     """(wy0, WH, wz0, WW): the tile-aligned bounding window of the face cut,
@@ -183,9 +268,7 @@ def pack_face_pallas_batched(
     yl, zl = y0 - wy0, z0 - wz0
 
     def kernel(u_ref, o_ref, win0, win1, s0, s1):
-        q = pl.program_id(0)
-        b = pl.program_id(1)
-        t = q * nb + b
+        t = pl.program_id(0) * nb + pl.program_id(1)
 
         def u_slice(tt):
             qq = tt // nb
@@ -194,26 +277,10 @@ def pack_face_pallas_batched(
                 qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH), pl.ds(wz0, WW)
             ]
 
-        def body(wa, sa, wb, sb):
-            @pl.when(t == 0)
-            def _():
-                pltpu.make_async_copy(u_slice(t), wa, sa).start()
-
-            pltpu.make_async_copy(u_slice(t), wa, sa).wait()
-
-            @pl.when(t + 1 < total)
-            def _():
-                pltpu.make_async_copy(u_slice(t + 1), wb, sb).start()
-
+        def emit(wa):
             o_ref[0] = wa[:, yl : yl + sy, zl : zl + sz]
 
-        @pl.when(t % 2 == 0)
-        def _():
-            body(win0, s0, win1, s1)
-
-        @pl.when(t % 2 == 1)
-        def _():
-            body(win1, s1, win0, s0)
+        _two_slot_fetch(t, total, u_slice, (win0, win1), (s0, s1), emit)
 
     return pl.pallas_call(
         kernel,
@@ -227,6 +294,7 @@ def pack_face_pallas_batched(
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
+        compiler_params=_SEQUENTIAL_GRID,
         interpret=interpret,
     )(u)
 
@@ -253,61 +321,24 @@ def unpack_face_pallas_batched(
     yl, zl = y0 - wy0, z0 - wz0
 
     def kernel(u_ref, f_ref, o_ref, win0, win1, s0i, s1i, s0o, s1o):
-        q = pl.program_id(0)
-        b = pl.program_id(1)
-        t = q * nb + b
+        t = pl.program_id(0) * nb + pl.program_id(1)
 
-        def u_slice(ref, tt):
-            qq = tt // nb
-            bb = tt - qq * nb
-            return ref.at[
-                qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH), pl.ds(wz0, WW)
-            ]
+        def slice_of(ref):
+            def at(tt):
+                qq = tt // nb
+                bb = tt - qq * nb
+                return ref.at[
+                    qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH),
+                    pl.ds(wz0, WW)
+                ]
 
-        def body(wa, sai, sao, wb, sbi, sbo):
-            @pl.when(t == 0)
-            def _():
-                pltpu.make_async_copy(u_slice(u_ref, t), wa, sai).start()
+            return at
 
-            pltpu.make_async_copy(u_slice(u_ref, t), wa, sai).wait()
-
-            @pl.when(t + 1 < total)
-            def _():
-                # slot b is reused for the t+1 fetch: its t-1 write-back must
-                # have drained first (and the fetch reads row range t+1,
-                # disjoint from write-back t's rows, so the two can fly
-                # together)
-                @pl.when(t >= 1)
-                def _():
-                    pltpu.make_async_copy(
-                        wb, u_slice(o_ref, t - 1), sbo
-                    ).wait()
-
-                pltpu.make_async_copy(u_slice(u_ref, t + 1), wb, sbi).start()
-
+        def merge(wa):
             wa[:, yl : yl + sy, zl : zl + sz] = f_ref[0]
-            pltpu.make_async_copy(wa, u_slice(o_ref, t), sao).start()
 
-            @pl.when(t == total - 1)
-            def _():
-                # drain BOTH slots before the kernel exits: slot b's
-                # write-back (posted at t-1) was only ever waited by the
-                # next prefetch, which doesn't run on the last step
-                @pl.when(t >= 1)
-                def _():
-                    pltpu.make_async_copy(
-                        wb, u_slice(o_ref, t - 1), sbo
-                    ).wait()
-
-                pltpu.make_async_copy(wa, u_slice(o_ref, t), sao).wait()
-
-        @pl.when(t % 2 == 0)
-        def _():
-            body(win0, s0i, s0o, win1, s1i, s1o)
-
-        @pl.when(t % 2 == 1)
-        def _():
-            body(win1, s1i, s1o, win0, s0i, s0o)
+        _two_slot_rmw(t, total, slice_of(u_ref), slice_of(o_ref),
+                      (win0, win1), (s0i, s1i), (s0o, s1o), merge)
 
     return pl.pallas_call(
         kernel,
@@ -327,6 +358,7 @@ def unpack_face_pallas_batched(
             pltpu.SemaphoreType.DMA,
         ],
         input_output_aliases={0: 0},
+        compiler_params=_SEQUENTIAL_GRID,
         interpret=interpret,
     )(u, face)
 
@@ -348,10 +380,8 @@ def pack_face_flat_pallas(
     DMA bytes).  Requires sz % 128 == 0 (the ``_flat_ok`` gate): that keeps
     every (BX, sy, sz) block row-aligned in the flat buffer AND the relayout
     a sublane merge Mosaic can lower — z-faces (sz = radius) fail the Mosaic
-    relayout pass, probed on v5e.  NOTE: the two-slot DMA choreography here
-    (t==0 bootstrap, t+1 prefetch, slot-b drain) is intentionally identical
-    to pack_face_pallas_batched's — fix bugs in BOTH (and in the two unpack
-    twins)."""
+    relayout pass, probed on v5e.  The two-slot DMA choreography is the
+    shared ``_two_slot_fetch`` — one definition for both pack kernels."""
     nq, sx, sy, sz = sizes
     _, x0, y0, z0 = starts
     _, _, Y, Z = u.shape
@@ -364,9 +394,7 @@ def pack_face_flat_pallas(
     yl, zl = y0 - wy0, z0 - wz0
 
     def kernel(u_ref, o_ref, win0, win1, s0, s1):
-        q = pl.program_id(0)
-        b = pl.program_id(1)
-        t = q * nb + b
+        t = pl.program_id(0) * nb + pl.program_id(1)
 
         def u_slice(tt):
             qq = tt // nb
@@ -375,26 +403,10 @@ def pack_face_flat_pallas(
                 qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH), pl.ds(wz0, WW)
             ]
 
-        def body(wa, sa, wb, sb):
-            @pl.when(t == 0)
-            def _():
-                pltpu.make_async_copy(u_slice(t), wa, sa).start()
-
-            pltpu.make_async_copy(u_slice(t), wa, sa).wait()
-
-            @pl.when(t + 1 < total)
-            def _():
-                pltpu.make_async_copy(u_slice(t + 1), wb, sb).start()
-
+        def emit(wa):
             o_ref[...] = wa[:, yl : yl + sy, zl : zl + sz].reshape(br, 128)
 
-        @pl.when(t % 2 == 0)
-        def _():
-            body(win0, s0, win1, s1)
-
-        @pl.when(t % 2 == 1)
-        def _():
-            body(win1, s1, win0, s0)
+        _two_slot_fetch(t, total, u_slice, (win0, win1), (s0, s1), emit)
 
     return pl.pallas_call(
         kernel,
@@ -409,6 +421,7 @@ def pack_face_flat_pallas(
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
+        compiler_params=_SEQUENTIAL_GRID,
         interpret=interpret,
     )(u)
 
@@ -435,54 +448,24 @@ def unpack_face_flat_pallas(
     yl, zl = y0 - wy0, z0 - wz0
 
     def kernel(u_ref, f_ref, o_ref, win0, win1, s0i, s1i, s0o, s1o):
-        q = pl.program_id(0)
-        b = pl.program_id(1)
-        t = q * nb + b
+        t = pl.program_id(0) * nb + pl.program_id(1)
 
-        def u_slice(ref, tt):
-            qq = tt // nb
-            bb = tt - qq * nb
-            return ref.at[
-                qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH), pl.ds(wz0, WW)
-            ]
+        def slice_of(ref):
+            def at(tt):
+                qq = tt // nb
+                bb = tt - qq * nb
+                return ref.at[
+                    qq, pl.ds(x0 + bb * BX, BX), pl.ds(wy0, WH),
+                    pl.ds(wz0, WW)
+                ]
 
-        def body(wa, sai, sao, wb, sbi, sbo):
-            @pl.when(t == 0)
-            def _():
-                pltpu.make_async_copy(u_slice(u_ref, t), wa, sai).start()
+            return at
 
-            pltpu.make_async_copy(u_slice(u_ref, t), wa, sai).wait()
-
-            @pl.when(t + 1 < total)
-            def _():
-                @pl.when(t >= 1)
-                def _():
-                    pltpu.make_async_copy(
-                        wb, u_slice(o_ref, t - 1), sbo
-                    ).wait()
-
-                pltpu.make_async_copy(u_slice(u_ref, t + 1), wb, sbi).start()
-
+        def merge(wa):
             wa[:, yl : yl + sy, zl : zl + sz] = f_ref[...].reshape(BX, sy, sz)
-            pltpu.make_async_copy(wa, u_slice(o_ref, t), sao).start()
 
-            @pl.when(t == total - 1)
-            def _():
-                @pl.when(t >= 1)
-                def _():
-                    pltpu.make_async_copy(
-                        wb, u_slice(o_ref, t - 1), sbo
-                    ).wait()
-
-                pltpu.make_async_copy(wa, u_slice(o_ref, t), sao).wait()
-
-        @pl.when(t % 2 == 0)
-        def _():
-            body(win0, s0i, s0o, win1, s1i, s1o)
-
-        @pl.when(t % 2 == 1)
-        def _():
-            body(win1, s1i, s1o, win0, s0i, s0o)
+        _two_slot_rmw(t, total, slice_of(u_ref), slice_of(o_ref),
+                      (win0, win1), (s0i, s1i), (s0o, s1o), merge)
 
     return pl.pallas_call(
         kernel,
@@ -502,6 +485,7 @@ def unpack_face_flat_pallas(
             pltpu.SemaphoreType.DMA,
         ],
         input_output_aliases={0: 0},
+        compiler_params=_SEQUENTIAL_GRID,
         interpret=interpret,
     )(u, flat)
 
@@ -541,20 +525,23 @@ class PackXla(PackFlat):
         self._name = f"pack_{dir_name(d)}.xla"
 
 
-def _face_bx(args: HaloArgs, d, which: str = "pack", itemsize: int = 4) -> int:
+def _face_bx(args: HaloArgs, d, which: str = "pack") -> int:
     """The batched kernels' rows-per-DMA for this face (1 means the batched
     variant degenerates to the per-row kernel and is left off the menu).
     ``which`` picks the window the kernel will actually DMA — the pack reads
     the interior edge, the unpack RMWs the ghost shell, and the two can span
-    a different number of sublane tiles."""
+    a different number of sublane tiles.  The itemsize comes from the grid
+    dtype in ``args`` so the gate agrees with the BX the kernels compute from
+    ``u.dtype.itemsize`` (a 2-byte grid halves the sublane tile)."""
     from tenzing_tpu.models.halo_pipeline import _padded_shape
 
+    itemsize = args.itemsize()
     starts, sizes = _face_slices(args, d, "pack")
     if which == "unpack":
         starts, _ = _face_slices(args, d, "unpack")
     _, sx, sy, sz = sizes
     _, _, y0, z0 = starts
-    _, _, Y, Z = _padded_shape(args.local_shape())
+    _, _, Y, Z = _padded_shape(args.local_shape(), itemsize)
     _, WH, _, WW = _tile_window(y0, sy, z0, sz, Y, Z, itemsize)
     return _batch_rows(sx, WH * WW * itemsize)
 

@@ -79,6 +79,103 @@ def test_deserialize_descends_into_choices():
     assert out[0].name() == "slow"
 
 
+def test_deserialize_descends_nested_choice_in_choice_alternative():
+    """A ChoiceOp nested deeper inside a choice alternative (directly, or via
+    an alternative's compound sub-graph) must resolve the same as a top-level
+    one — reference operation_serdes.cpp:14-56 recurses uniformly."""
+
+    class Inner(ChoiceOp):
+        def choices(self):
+            return [KOp("deep_fast"), KOp("deep_slow")]
+
+    class Wrap(CompoundOp):
+        def graph(self):
+            ig = Graph()
+            inner = Inner("inner")
+            ig.start_then(inner)
+            ig.then_finish(inner)
+            return ig
+
+    class Outer(ChoiceOp):
+        def choices(self):
+            # alternative 0: a ChoiceOp directly; alternative 1: a compound
+            # whose sub-graph holds another ChoiceOp
+            return [Inner("direct_inner"), Wrap("wrap")]
+
+    g = Graph()
+    g.start_then(Outer("outer"))
+    g.then_finish(Outer("outer"))
+    for name in ("deep_fast", "deep_slow"):
+        out = sequence_from_json_str(
+            '[{"kind": "device", "name": "%s", "lane": 2}]' % name, g
+        )
+        assert isinstance(out[0], BoundDeviceOp) and out[0].name() == name
+        assert out[0].lane() == Lane(2)
+
+
+def test_deserialize_random_nested_structures():
+    """Generative: random compound/choice nestings up to depth 4; every leaf
+    device op anywhere in the structure must anchor by name."""
+    import random
+
+    rng = random.Random(20260731)
+
+    def build(depth, counter, leaves):
+        roll = rng.random()
+        if depth >= 4 or roll < 0.4:
+            op = KOp("leaf%d" % counter[0])
+            counter[0] += 1
+            leaves.append(op.name())
+            return op
+        if roll < 0.7:
+            kids = [build(depth + 1, counter, leaves) for _ in range(rng.randint(1, 3))]
+
+            class C(ChoiceOp):
+                def __init__(self, name, ks):
+                    super().__init__(name)
+                    self._ks = ks
+
+                def choices(self):
+                    return self._ks
+
+            counter[0] += 1
+            return C("choice%d" % counter[0], kids)
+        kids = [build(depth + 1, counter, leaves) for _ in range(rng.randint(1, 3))]
+
+        class P(CompoundOp):
+            def __init__(self, name, ks):
+                super().__init__(name)
+                self._ks = ks
+
+            def graph(self):
+                ig = Graph()
+                prev = None
+                for k in self._ks:
+                    if prev is None:
+                        ig.start_then(k)
+                    else:
+                        ig.then(prev, k)
+                    prev = k
+                ig.then_finish(prev)
+                return ig
+
+        counter[0] += 1
+        return P("comp%d" % counter[0], kids)
+
+    for trial in range(10):
+        leaves = []
+        root = build(0, [trial * 1000], leaves)
+        g = Graph()
+        g.start_then(root)
+        g.then_finish(root)
+        assert leaves, "degenerate trial"
+        for name in leaves:
+            out = sequence_from_json_str(
+                '[{"kind": "device", "name": "%s", "lane": 0}]' % name, g
+            )
+            assert out[0].name() == name
+
+
 def test_unknown_op_raises():
     g = Graph()
     with pytest.raises(KeyError):
