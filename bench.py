@@ -72,6 +72,23 @@ def probe_backend(retries: int = 1, wait_secs: float = 15.0):
     raise AssertionError("unreachable")  # pragma: no cover
 
 
+# the measured per-face aliased-unpack recipe (the r5 discovery, see
+# experiments/MENU_INCUMBENT2.json / MENU_INCUMBENT3.json): the ghost-shell
+# write must lower IN PLACE (a non-aliased write copies the 2.07 GB grid,
+# ~5 ms) and these are the aliased Pallas kernels per face axis.  ONE
+# definition — the greedy incumbents and the climb seeds must refine the
+# same recipe.
+ALIAS_UNPACK = {"x": ".pallas", "y": ".pallasf", "z": ".pallasb"}
+
+
+def alias_unpack_choice(op_name, choices):
+    """The aliased kernel for an ``unpack_*`` op from the menu, or None when
+    it is off-menu — the one lookup both the greedy seeding and the climb
+    disciplines share."""
+    want = ALIAS_UNPACK[op_name[-1]]
+    return next((c for c in choices if c.endswith(want)), None)
+
+
 def metric_for(workload: str, args) -> str:
     """The metric name for a workload config — the single source both the
     success path (build_* return) and the backend-init-failure path use, so
@@ -377,10 +394,15 @@ def main() -> int:
                     def prefer(op_name, choices):
                         if op_name.startswith("xfer_"):
                             i = _dirs.index(op_name.split("_", 1)[1])
-                            want = {"host": ".host", "rdma": ".rdma"}.get(
+                            want = {"host": ".host", "rdma": ".rdma",
+                                    "alias": ".rdma"}.get(
                                 engine, ".rdma" if i % 2 == 0 else ".host")
                             return next(
                                 (c for c in choices if c.endswith(want)), None)
+                        if engine == "alias" and op_name.startswith("unpack_"):
+                            hit = alias_unpack_choice(op_name, choices)
+                            if hit is not None:
+                                return hit
                         return next(
                             (c for c in choices if c.endswith(".xla")), None)
 
@@ -388,13 +410,15 @@ def main() -> int:
 
                 # search-platform (8-lane) incumbents are driven on the
                 # CHOICE graph itself, and their decision paths double as the
-                # MCTS warm-start seeds — so the seed iterations are exact
-                # cache hits on the incumbents' measurements
+                # MCTS warm-start seeds (re-measured at the cheap screen
+                # floor — a few ms of device time — since the multi-fidelity
+                # split keys the cache per-floor)
                 for label, engine, pri in (
                     ("greedy-host-8l", "host", None),
                     ("greedy-rdma-8l", "rdma", None),
                     ("greedy-mixed-8l", "mixed", None),
                     ("greedy-paired-8l", "mixed", paired_priority("mixed")),
+                    ("greedy-alias-8l", "alias", None),
                 ):
                     seq, decs = drive(g, plat, phase_policy(
                         plat, _PH, mk_prefer(engine), priority=pri))
@@ -411,6 +435,17 @@ def main() -> int:
                         built[3], Platform.make_n_lanes(nl), engine=engine)))
                 greedy_seqs.append(("greedy-paired-6l", paired_overlap_order(
                     built[3], Platform.make_n_lanes(6), engine="mixed")))
+                # the aliased-unpack recipe at the probed lane counts
+                # (experiments/MENU_INCUMBENT3.json: 3.2-3.4x paired at
+                # 2/3/6 lanes, best at 6) — driven on the choice graph so
+                # their decision paths also seed the tree
+                for label, nl in (("greedy-alias-3l", 3),
+                                  ("greedy-alias-6l", 6)):
+                    plat_a = Platform.make_n_lanes(nl)
+                    seq, decs = drive(g, plat_a, phase_policy(
+                        plat_a, _PH, mk_prefer("alias")))
+                    greedy_seqs.append((label, seq))
+                    seed_paths.append(decs)
         else:
             from tenzing_tpu.models.moe_pipeline import greedy_overlap_order
 
@@ -524,22 +559,38 @@ def main() -> int:
         seed_paths.append(decs)
 
     # directed search over the order x lane x kernel x engine space, at the
-    # cheap search-phase measurement cost
+    # cheap search-phase measurement cost.  Multi-fidelity (VERDICT r4 item
+    # 2): rollouts are measured at a ~1 ms screen floor — search-time numbers
+    # only steer the tree — and the top-k distinct schedules are re-measured
+    # at the climb floor before the dump, so MCTS's official candidates carry
+    # comparable-fidelity numbers into the paired screen
     t0 = time.time()
+    mcts_screen = BenchOpts(
+        n_iters=2, max_retries=2,
+        target_secs=0.0005 if args.smoke else 0.001,
+    )
+    mcts_confirm = BenchOpts(
+        n_iters=max(5, args.iters), max_retries=2,
+        target_secs=search_opts.target_secs * 10,
+    )
     res = explore(
         g,
         plat,
         bench,
-        MctsOpts(n_iters=args.mcts_iters, bench_opts=search_opts, seed=0),
+        MctsOpts(n_iters=args.mcts_iters, bench_opts=mcts_confirm,
+                 screen_opts=mcts_screen, confirm_topk=4, seed=0),
         strategy=FastMin,
         seeds=seed_paths,
     )
+    confirmed = [s for s in res.sims if s.fidelity == "full"]
     best_seen = min(
-        (s.result.pct50 for s in res.sims), default=float("inf")
+        (s.result.pct50 for s in (confirmed or res.sims)),
+        default=float("inf"),
     )
     sys.stderr.write(
         f"mcts wall {time.time()-t0:.0f}s, tree={res.tree_size}, "
-        f"{len(res.sims)} rollouts ({len(seed_paths)} seeded), "
+        f"{len(res.sims)} rollouts ({len(seed_paths)} seeded, "
+        f"{len(confirmed)} confirmed at {mcts_confirm.target_secs}s floor), "
         f"best-seen pct50={best_seen*1e6:.1f}us\n"
     )
     # where the search wall goes (VERDICT r3 weak #5): per-phase counters +
@@ -605,19 +656,30 @@ def main() -> int:
                 return next((c for c in choices if c.endswith(".rdma")), None)
             return next((c for c in choices if c.endswith(".xla")), None)
 
+        def alias_prefer(op_name, choices):
+            # all-rdma + the aliased-unpack kernel map (the measured r5
+            # recipe: in-place ghost-shell writes per face, MENU_INCUMBENT2/3)
+            if op_name.startswith("xfer_"):
+                return next((c for c in choices if c.endswith(".rdma")), None)
+            if op_name.startswith("unpack_"):
+                hit = alias_unpack_choice(op_name, choices)
+                if hit is not None:
+                    return hit
+            return next((c for c in choices if c.endswith(".xla")), None)
+
         # climbs: one seeded from the best RECORDED schedule's menu choices
         # (when a database is present — the cross-run memory), then the two
-        # strongest post-index-tie disciplines (the r4e final: all-rdma at
-        # 2-3 lanes leads), split 4:3: one refines the rdma-3l winner
-        # (kernel flips — e.g. the aliased Pallas unpack — plus order/lane
-        # moves), one climbs the paired-interleave variant
+        # strongest measured disciplines, split 4:3: the aliased-unpack
+        # all-rdma recipe at its two best probed lane counts
+        # (MENU_INCUMBENT3.json: 3.2-3.4x paired at 3 and 6 lanes) — the
+        # climb refines order/lane/kernel-flip moves from there
         b_rec = (args.climb_budget // 3) if recorded else 0
         rest = args.climb_budget - b_rec
         b1 = (rest * 4) // 7
         plat3 = Platform.make_n_lanes(3)
         climb_cfg = [
-            (plat3, HALO_PHASES, rdma_prefer, None, b1),
-            (plat3, HALO_PHASES, rdma_prefer, paired_priority("rdma"),
+            (plat3, HALO_PHASES, alias_prefer, None, b1),
+            (Platform.make_n_lanes(6), HALO_PHASES, alias_prefer, None,
              rest - b1),
         ]
         if b_rec:
@@ -733,16 +795,21 @@ def main() -> int:
 
     # distinct candidates by canonical key; heuristic incumbents always
     # advance to screening (search-time noise must not knock them out).
-    # MCTS and climb sims were measured under DIFFERENT adaptive floors
-    # (0.01s vs 0.1s), so their pct50s are not cross-comparable: each pool is
-    # sorted within its own regime and the screen slots interleave the pools
-    # instead of ranking them jointly.
+    # The mcts pool is the confirm-pass sims (re-measured at the same 10x
+    # floor the climbs use), but each pool is still sorted within itself and
+    # the screen slots interleave the pools: measurements taken minutes
+    # apart on a drifting chip are safer ranked per-pool than jointly.
     from itertools import chain, zip_longest
 
     seen = set()
     cands = []
     inc_ids = {id(s) for s in incumbents}
-    others = [s for s in res.sims if id(s) not in inc_ids]
+    # screen-fidelity MCTS rollouts never advance directly: their ~1 ms-floor
+    # pct50s are not comparable with any other pool, and the confirm pass
+    # already re-measured the best of them at the climb floor
+    others = [s for s in res.sims
+              if id(s) not in inc_ids
+              and getattr(s, "fidelity", "full") == "full"]
     pools = {
         label: sorted(
             (s for s in others if incumbent_labels.get(id(s), "mcts") == label),
@@ -770,8 +837,14 @@ def main() -> int:
     value_us = naive.pct50 * 1e6
     finals = []
     top = []
+    # constructed unconditionally: the regime metadata in the final JSON
+    # reads the ACTUAL floors these carry, so tuning a multiplier at one
+    # site cannot silently desynchronize the reported metadata
+    screen_opts = replace(opts, target_secs=5 * opts.target_secs)
+    fin_opts = replace(
+        opts, n_iters=3 * opts.n_iters, target_secs=20 * opts.target_secs
+    )
     if cands:
-        screen_opts = replace(opts, target_secs=5 * opts.target_secs)
         for attempt in range(2):
             t0 = time.time()
             _, screen = batch_paired(
@@ -819,9 +892,6 @@ def main() -> int:
         # the final batch reports no sub-1.0 losers
         top = [s for s, p in ranked if p[0] > 1.0][:3]
     if top:
-        fin_opts = replace(
-            opts, n_iters=3 * opts.n_iters, target_secs=20 * opts.target_secs
-        )
         t0 = time.time()
         finals, paired = batch_paired([s.order for s in top], fin_opts, seed=3)
         fin_naive, fin_cands = finals[0], finals[1:]
@@ -871,10 +941,38 @@ def main() -> int:
                 idx = next(i for i, s2 in enumerate(res.sims) if s2 is s)
                 results[1 + idx] = r
         orders = [naive_seq] + [s.order for s in res.sims]
-        rows = [result_row(i, r, o) for i, (r, o) in enumerate(zip(results, orders))]
+        # fidelity tags keep the DB honest: MCTS screen rows were measured at
+        # a ~1 ms floor and must not be ranked against full-floor rows by the
+        # warm-start loader (bench/recorded.py skips non-"full" rows)
+        fids = ["full"] + [getattr(s, "fidelity", "full") for s in res.sims]
+        if finals:
+            for s in top:
+                idx = next(i for i, s2 in enumerate(res.sims) if s2 is s)
+                fids[1 + idx] = "full"  # superseded by the final batch
+        # screen rows cannot shadow full-fidelity twins on replay:
+        # CsvBenchmarker only admits "full" rows into its equivalence cache
+        rows = [
+            result_row(i, r, o, fidelity=None if f == "full" else f)
+            for i, (r, o, f) in enumerate(zip(results, orders, fids))
+        ]
         with open(args.dump_csv, "w") as f:
             f.write("\n".join(rows) + "\n")
         sys.stderr.write(f"csv: {args.dump_csv} ({len(rows)} rows)\n")
+    # regime metadata (VERDICT r4 item 6): cross-round vs_baseline
+    # comparisons need the chip regime (naive_us), the measurement floors
+    # that produced the verdict, and the warm-start provenance — without
+    # them the parsed series quietly compares different machines
+    meta = {
+        "naive_us": round(
+            (finals[0].pct50 if finals else naive.pct50) * 1e6, 2),
+        "search_floor_s": search_opts.target_secs,
+        "screen_floor_s": screen_opts.target_secs,
+        "final_floor_s": fin_opts.target_secs,
+        "mcts_screen_floor_s": mcts_screen.target_secs,
+        "winner_label": (label_of(top[best_i])
+                         if top and finals and vs > 1.0 else None),
+        "recorded_seeds": len(recorded),
+    }
     print(
         json.dumps(
             {
@@ -882,6 +980,7 @@ def main() -> int:
                 "value": round(value_us, 2),
                 "unit": "us",
                 "vs_baseline": round(vs, 4),
+                **meta,
             }
         )
     )

@@ -1,0 +1,184 @@
+"""In-context per-op costs for the halo flagship — the corrected menu bound.
+
+MENU_INCUMBENT.json falsified the r4 menu bound: composing the per-face
+kernel minima from KERNEL_MICROBENCH.json (fetch-fenced jit-chain slopes)
+produces schedules 1.3-1.6x SLOWER than naive, while the real winners
+(halo_search_tpu_r4{k,y,z}.csv) choose almost the opposite menu — all-XLA
+packs, all-rdma transfers, Pallas-batched z-unpacks only.  The chain-slope
+numbers do not survive executor context (different fusion, token-lane
+ordering, VMEM pressure, core serialization of Pallas kernels).
+
+This experiment measures every menu variant of every face op as a ONE-OP
+schedule under the same TraceExecutor + EmpiricalBenchmarker the search
+uses (adaptive >=10x floor, fetch-fenced), plus the winner-recipe phase
+cumulative (packs -> +xfers -> full) — the decomposition VERDICT r4 item 1
+option (b) asks for.  Output: experiments/HALO_INCONTEXT.json with
+ * per_op_ms: in-context cost of each variant,
+ * menu_min_ms: the corrected serial compute floor (sum of per-op minima),
+ * cumulative_ms: where the winner recipe's time actually goes.
+
+Run alone on the real chip (memory: tpu-bench-hygiene).
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    import jax
+
+    from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.halo import DIRECTIONS, HaloArgs, dir_name
+    from tenzing_tpu.models.halo_pipeline import (
+        HALO_PHASES,
+        direction_ops,
+        host_buffer_names,
+        make_pipeline_buffers,
+    )
+    from tenzing_tpu.ops.halo_pallas import (
+        PackChoice,
+        UnpackChoice,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.greedy import greedy_phase_order
+
+    hargs = HaloArgs(nq=3, lx=512, ly=512, lz=512, radius=3)
+    bufs, _ = make_pipeline_buffers(hargs, seed=0, with_expected=False)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    plat2 = Platform.make_n_lanes(2)
+    ex = TraceExecutor(Platform.make_n_lanes(8), jbufs)
+    emp = EmpiricalBenchmarker(ex)
+    opts = BenchOpts(n_iters=6, target_secs=0.05, max_retries=2)
+
+    def timed(label, build, plat=None):
+        g = Graph()
+        build(g)
+        seq = greedy_phase_order(g, plat if plat is not None else plat2,
+                                 HALO_PHASES)
+        t0 = time.time()
+        try:
+            res = emp.benchmark(seq, opts)
+        except Exception as e:
+            sys.stderr.write(f"{label}: FAILED {type(e).__name__}: "
+                             f"{str(e)[:120]}\n")
+            return None
+        sys.stderr.write(
+            f"{label}: pct50={res.pct50*1e3:.4f}ms "
+            f"(wall {time.time()-t0:.0f}s)\n")
+        return res.pct50 * 1e3
+
+    per_op = {}
+    # one representative direction per axis (+/- are symmetric shapes)
+    for d in [(1, 0, 0), (0, 1, 0), (0, 0, 1)]:
+        dn = dir_name(d)
+        pc, uc = PackChoice(hargs, d), UnpackChoice(hargs, d)
+        for op in pc.choices():
+
+            def one(g, op=op):
+                g.start_then(op)
+                g.then_finish(op)
+
+            per_op[op.name()] = timed(op.name(), one)
+        for op in uc.choices():
+
+            def one(g, op=op):
+                g.start_then(op)
+                g.then_finish(op)
+
+            per_op[op.name()] = timed(op.name(), one)
+        # transfer engines for this axis (buf_<d> staged already in bufs)
+        for engine in ("host", "rdma"):
+            ops = direction_ops(hargs, d, engine=engine)
+            xfer_chain = ops[1:-1]  # spill/fetch or rdma, plus await
+
+            def chain(g, xfer_chain=xfer_chain):
+                g.start_then(xfer_chain[0])
+                for a, b in zip(xfer_chain, xfer_chain[1:]):
+                    g.then(a, b)
+                g.then_finish(xfer_chain[-1])
+
+            per_op[f"xfer_{dn}.{engine}"] = timed(f"xfer_{dn}.{engine}",
+                                                  chain)
+
+    # corrected serial compute floor: per-axis minima x2 directions
+    menu_min = 0.0
+    per_axis = {}
+    for ax in ("px", "py", "pz"):
+        pmin = min(v for k, v in per_op.items()
+                   if k.startswith(f"pack_{ax}.") and v is not None)
+        umin = min(v for k, v in per_op.items()
+                   if k.startswith(f"unpack_{ax}.") and v is not None)
+        xmin = min(v for k, v in per_op.items()
+                   if k.startswith(f"xfer_{ax}.") and v is not None)
+        per_axis[ax] = {"pack_min_ms": pmin, "unpack_min_ms": umin,
+                        "xfer_min_ms": xmin,
+                        "pack_argmin": min(
+                            ((v, k) for k, v in per_op.items()
+                             if k.startswith(f"pack_{ax}.") and v is not None)
+                        )[1],
+                        "unpack_argmin": min(
+                            ((v, k) for k, v in per_op.items()
+                             if k.startswith(f"unpack_{ax}.")
+                             and v is not None)
+                        )[1]}
+        menu_min += 2 * (pmin + umin)
+
+    # winner-recipe cumulative, as explicit per-direction chain prefixes:
+    # all-XLA packs, rdma transfers, z-unpacks pallasb / rest xla (the
+    # revealed choice of the r4{k,y,z} winners)
+    from tenzing_tpu.ops.comm_ops import AwaitTransfer
+    from tenzing_tpu.ops.halo_pallas import PackXla, UnpackPallasB, UnpackXla
+    from tenzing_tpu.ops.rdma import RdmaCopyStart
+
+    def winner_chain(d):
+        dn = dir_name(d)
+        pack = PackXla(hargs, d)
+        xfer = RdmaCopyStart(f"xfer_{dn}.rdma", f"buf_{dn}", f"recv_{dn}")
+        await_ = AwaitTransfer(f"await_{dn}", f"recv_{dn}")
+        unpack = (UnpackPallasB if d[2] != 0 else UnpackXla)(hargs, d)
+        return [pack, xfer, await_, unpack]
+
+    cumulative = {}
+
+    def chains_prefix(label, n_ops):
+        """All six directions' winner chains truncated to ``n_ops`` ops."""
+
+        def build(g):
+            for d in DIRECTIONS:
+                ops = winner_chain(d)[:n_ops]
+                g.start_then(ops[0])
+                for a, b in zip(ops, ops[1:]):
+                    g.then(a, b)
+                g.then_finish(ops[-1])
+
+        return timed(f"cumulative {label}", build,
+                     plat=Platform.make_n_lanes(3))
+
+    cumulative["packs"] = chains_prefix("packs", 1)
+    cumulative["packs+xfers"] = chains_prefix("packs+xfers", 2)
+    cumulative["packs+xfers+awaits"] = chains_prefix("packs+xfers+awaits", 3)
+    cumulative["full"] = chains_prefix("full", 4)
+
+    out = {
+        "device": str(jax.devices()[0]),
+        "protocol": "one-op schedules, EmpiricalBenchmarker n_iters=6 "
+                    "floor 0.05s, 2-lane greedy",
+        "per_op_ms": per_op,
+        "per_axis": per_axis,
+        "menu_min_serial_ms": menu_min,
+        "cumulative_ms": cumulative,
+    }
+    path = Path(__file__).parent / "HALO_INCONTEXT.json"
+    path.write_text(json.dumps(out, indent=1))
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""Winner-recipe neighborhood probe with aliasing-aware substitutions.
+
+HALO_INCONTEXT.json (one-op schedules) and MENU_INCUMBENT.json (menu-argmin
+compositions) together falsify additive per-op cost models for this
+workload: isolated and composed costs differ 10-100x in both directions.
+The physically dominant effect at nq=3, 512^3 f32 (U = 2.07 GB) is whether
+the ghost-shell write lowers IN PLACE — a full-U copy is ~5 ms of HBM
+traffic, and the r4 winners' one consistent menu deviation (z-unpacks via
+the ALIASED batched Pallas kernel) is exactly an in-place guarantee, not a
+kernel-speed win.
+
+This probe measures, as ONE decorrelated paired batch against naive, the
+exact r4z winner recipe plus single aimed substitutions that extend the
+aliasing guarantee (and the flat-staging kernels) to the other faces:
+
+  w0  r4z recipe: all-XLA packs, all-rdma, z-unpacks pallasb, 3 lanes
+  w1  w0 + y-unpacks -> .pallasf   (aliased + consumes staging directly;
+                                    0.44 ms one-op vs 67 ms XLA DUS)
+  w2  w0 + x-unpacks -> .pallas    (aliased per-row window kernel)
+  w3  all unpacks aliased: x .pallas, y .pallasf, z .pallasb
+  w4  w3 + x/y packs -> .pallasf   (emit staging in-kernel)
+  w5  w0 + z-unpacks -> .pallas    (aliased per-row instead of batched)
+
+Output: experiments/MENU_INCUMBENT2.json.  Run alone on the real chip
+(memory: tpu-bench-hygiene).
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def mk_prefer(unpack_map, pack_map):
+    def prefer(op_name, choices):
+        if op_name.startswith("xfer_"):
+            return next((c for c in choices if c.endswith(".rdma")), None)
+        axis = op_name.rsplit("_", 1)[1][-1]  # x / y / z
+        table = unpack_map if op_name.startswith("unpack_") else pack_map
+        want = table.get(axis, ".xla")
+        hit = next((c for c in choices if c.endswith(want)), None)
+        return hit if hit is not None else next(
+            (c for c in choices if c.endswith(".xla")), None)
+
+    return prefer
+
+
+VARIANTS = [
+    ("w0-r4z", {"z": ".pallasb"}, {}),
+    ("w1-yflat", {"z": ".pallasb", "y": ".pallasf"}, {}),
+    ("w2-xrow", {"z": ".pallasb", "x": ".pallas"}, {}),
+    ("w3-allalias", {"z": ".pallasb", "y": ".pallasf", "x": ".pallas"}, {}),
+    ("w4-packsflat", {"z": ".pallasb", "y": ".pallasf", "x": ".pallas"},
+     {"x": ".pallasf", "y": ".pallasf"}),
+    ("w5-zrow", {"z": ".pallas"}, {}),
+]
+
+
+def main() -> int:
+    import jax
+
+    from tenzing_tpu.bench.benchmarker import (
+        BenchOpts,
+        BenchResult,
+        EmpiricalBenchmarker,
+    )
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.models.halo_pipeline import (
+        HALO_PHASES,
+        build_graph,
+        host_buffer_names,
+        make_pipeline_buffers,
+        naive_order,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.local import drive, phase_policy
+    from tenzing_tpu.utils.numeric import paired_speedup
+
+    hargs = HaloArgs(nq=3, lx=512, ly=512, lz=512, radius=3)
+    bufs, _ = make_pipeline_buffers(hargs, seed=0, with_expected=False)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    g = build_graph(hargs, impl_choice=True, xfer_choice=True)
+    naive_seq = naive_order(hargs, Platform.make_n_lanes(1))
+    plat3 = Platform.make_n_lanes(3)
+
+    seqs = []
+    for label, umap, pmap in VARIANTS:
+        seq, _ = drive(g, plat3, phase_policy(
+            plat3, HALO_PHASES, mk_prefer(umap, pmap)))
+        seqs.append((label, seq))
+
+    ex = TraceExecutor(Platform.make_n_lanes(8), jbufs)
+    emp = EmpiricalBenchmarker(ex)
+    screen_opts = BenchOpts(n_iters=8, target_secs=0.1, max_retries=2)
+    t0 = time.time()
+    times = emp.benchmark_batch_times(
+        [naive_seq] + [s for _, s in seqs], screen_opts, seed=21)
+    rows = {}
+    for (label, _), ts in zip(seqs, times[1:]):
+        res = BenchResult.from_times(ts)
+        m, lo, hi = paired_speedup(times[0], ts, seed=22)
+        rows[label] = {"pct50_ms": res.pct50 * 1e3,
+                       "paired_vs_naive": [m, lo, hi]}
+        sys.stderr.write(
+            f"{label}: pct50={res.pct50*1e3:.3f}ms paired={m:.4f} "
+            f"[{lo:.4f},{hi:.4f}]\n")
+    naive_res = BenchResult.from_times(times[0])
+    out = {
+        "device": str(jax.devices()[0]),
+        "protocol": "one decorrelated paired batch, n_iters=8, floor 0.1s",
+        "naive_pct50_ms": naive_res.pct50 * 1e3,
+        "variants": rows,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    path = Path(__file__).parent / "MENU_INCUMBENT2.json"
+    path.write_text(json.dumps(out, indent=1))
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

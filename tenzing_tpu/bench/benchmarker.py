@@ -331,9 +331,13 @@ class CachingBenchmarker:
 CSV_DELIM = "|"
 
 
-def result_row(idx: int, res: BenchResult, order: Sequence) -> str:
+def result_row(idx: int, res: BenchResult, order: Sequence,
+               fidelity: Optional[str] = None) -> str:
     """One CSV row: ``idx|pct01|pct10|pct50|pct90|pct99|stddev|op-json|...``
-    (reference mcts.cpp:13-31 / dfs.cpp:84-105 dump format)."""
+    (reference mcts.cpp:13-31 / dfs.cpp:84-105 dump format).  ``fidelity``
+    (e.g. "screen" for a cheap multi-fidelity measurement) inserts a
+    ``fid=<tag>`` cell before the ops — readable by CsvBenchmarker, invisible
+    to rows that omit it, so legacy databases parse unchanged."""
     import json
 
     cells = [
@@ -344,7 +348,7 @@ def result_row(idx: int, res: BenchResult, order: Sequence) -> str:
         repr(res.pct90),
         repr(res.pct99),
         repr(res.stddev),
-    ] + [
+    ] + ([f"fid={fidelity}"] if fidelity is not None else []) + [
         # '|' can only occur inside JSON strings; the \\u007c escape keeps the
         # cell valid JSON while making the row safely splittable on the delimiter
         json.dumps(op.to_json()).replace(CSV_DELIM, "\\u007c")
@@ -380,6 +384,7 @@ class CsvBenchmarker:
 
         self._normalize = remove_redundant_syncs if normalize else (lambda s: s)
         self.entries: List[Tuple[Sequence, BenchResult]] = []
+        self.fidelities: List[str] = []  # parallel to entries; "full" legacy
         self._by_canonical: dict = {}  # canonical(normalized seq) -> result
         self.skipped: List[int] = []
         for i, row in enumerate(rows):
@@ -395,7 +400,13 @@ class CsvBenchmarker:
                     pct99=float(cells[5]),
                     stddev=float(cells[6]),
                 )
-                ops = [op_from_json(json.loads(c), graph) for c in cells[7:]]
+                # optional fidelity cell ("fid=screen") before the ops —
+                # absent in legacy rows, which start the ops at cells[7]
+                ops_at, fid = 7, "full"
+                if len(cells) > 7 and cells[7].startswith("fid="):
+                    fid = cells[7][4:]
+                    ops_at = 8
+                ops = [op_from_json(json.loads(c), graph) for c in cells[ops_at:]]
             except (KeyError, TypeError, ValueError, IndexError):
                 # malformed row (e.g. dump truncated mid-write) or ops recorded
                 # against a different structural variant
@@ -405,9 +416,15 @@ class CsvBenchmarker:
                 continue
             seq = Sequence(ops)
             self.entries.append((seq, res))
-            # first row wins for duplicate schedules (e.g. a search-time row
-            # superseded by a final-batch row earlier in the file)
-            self._by_canonical.setdefault(canonical_key(self._normalize(seq)), res)
+            self.fidelities.append(fid)
+            # first FULL row wins for duplicate schedules (e.g. a search-time
+            # row superseded by a final-batch row earlier in the file).
+            # Screen-fidelity rows never answer benchmark queries: their
+            # ~1 ms-floor numbers are bookkeeping, and letting one shadow a
+            # full-floor twin would replay ~100x off-regime measurements.
+            if fid == "full":
+                self._by_canonical.setdefault(
+                    canonical_key(self._normalize(seq)), res)
 
     @classmethod
     def from_file(cls, path: str, graph, strict: bool = True,

@@ -53,13 +53,18 @@ def rank_recorded(
             continue
         n_rows += len(db.entries)
         n_skip += len(db.skipped)
+        # parallel by construction (CsvBenchmarker appends both in one
+        # block); fail loudly rather than mislabel rows "full"
+        assert len(db.fidelities) == len(db.entries)
+        fids = db.fidelities
         if anchor is None:
             continue
-        for seq, res in db.entries:
-            # only rows that beat their own naive are worth carrying (this
-            # also drops the naive row itself, which resolves on menu-less
-            # graphs)
-            if res.pct50 > 0 and anchor / res.pct50 > 1.0:
+        for (seq, res), fid in zip(db.entries, fids):
+            # only FULL-fidelity rows that beat their own naive are worth
+            # carrying: a multi-fidelity screen row's pct50 came from a far
+            # cheaper measurement floor than the file's naive anchor, so its
+            # in-file ratio is not a regime-honest score
+            if fid == "full" and res.pct50 > 0 and anchor / res.pct50 > 1.0:
                 scored.append((anchor / res.pct50, seq))
     scored.sort(key=lambda e: -e[0])
     seen: set = set()

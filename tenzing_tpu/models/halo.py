@@ -82,6 +82,15 @@ class HaloArgs:
         return np.dtype(self.dtype).itemsize
 
 
+def sublane_tile(itemsize: int) -> int:
+    """TPU sublane tile for an element width (8 for 4-byte, 16 for 2-byte,
+    32 for 1-byte) — the ONE definition shared by the grid padding
+    (halo_pipeline._padded_shape) and the Pallas window/menu gating
+    (ops/halo_pallas._tile_window): the two must agree or the kernels'
+    tile-aligned HBM DMA windows fall outside the allocated padding."""
+    return {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+
+
 def _face_slices(args: HaloArgs, d: Tuple[int, int, int], which: str):
     """Start indices + sizes of the face region along direction ``d``:
     ``which`` = 'pack' (interior edge) or 'unpack' (ghost shell)."""

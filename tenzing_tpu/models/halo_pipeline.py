@@ -58,6 +58,7 @@ from tenzing_tpu.models.halo import (
     Unpack,
     _face_slices,
     dir_name,
+    sublane_tile,
 )
 from tenzing_tpu.ops.comm_ops import AwaitTransfer, HostFetchStart, HostSpillStart
 
@@ -342,7 +343,7 @@ def _padded_shape(shape: Tuple[int, int, int, int],
     (ops/halo_pallas.py), and the padding is invisible to the XLA slice path
     (all face slices are interior)."""
     nq, x, y, z = shape
-    st = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    st = sublane_tile(itemsize)
     return (nq, x, -(-y // st) * st, -(-z // 128) * 128)
 
 

@@ -22,6 +22,16 @@ storage-order question the reference's two kernel families answer — so it is
 exposed as a ChoiceOp and searched (SpMV's kernel menu precedent,
 models/spmv.py SpMVImplChoice).
 
+MEASURED (r5): the menu's value on the flagship is NOT kernel speed —
+isolated and composed per-op costs differ 10-100x in both directions
+(experiments/HALO_INCONTEXT.json vs MENU_INCUMBENT.json) because XLA
+fuses/aliases across the whole program.  The load-bearing property is the
+ALIASING GUARANTEE: at nq=3, 512^3 f32 the grid is 2.07 GB, a non-in-place
+ghost-shell write costs a ~5 ms full-U copy, and the measured winners pick
+exactly the aliased kernels per face (x .pallas, y .pallasf, z .pallasb —
+experiments/MENU_INCUMBENT2.json: 2.94x vs the XLA-unpack recipe's 2.51x in
+the same paired batch).
+
 Off-TPU the kernels run in the Pallas interpreter (``interpret=True``), same
 code path as the repo's other Pallas kernels.
 """
@@ -39,7 +49,12 @@ from jax.experimental.pallas import tpu as pltpu
 import numpy as np
 
 from tenzing_tpu.core.operation import ChoiceOp, OpBase
-from tenzing_tpu.models.halo import HaloArgs, _face_slices, dir_name
+from tenzing_tpu.models.halo import (
+    HaloArgs,
+    _face_slices,
+    dir_name,
+    sublane_tile,
+)
 from tenzing_tpu.models.halo_pipeline import (
     PackFlat,
     UnpackRecv,
@@ -147,7 +162,7 @@ def _tile_window(y0: int, sy: int, z0: int, sz: int,
     for sublane-thin faces (y-faces: one sublane-tile stripe) and 5x for
     lane-thin faces (z-faces: a (Y, 128) stripe).  The sublane tile scales
     with dtype width (8 for 4-byte, 16 for 2-byte, 32 for 1-byte)."""
-    st = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    st = sublane_tile(itemsize)
     wy0 = (y0 // st) * st
     wy1 = min(-(-(y0 + sy) // st) * st, Y)
     wz0 = (z0 // 128) * 128
