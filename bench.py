@@ -202,8 +202,9 @@ def build_attn(args):
     bufs, _ = make_blocked_buffers(aargs, seed=0)
     bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
     g = Graph()
-    g.start_then(BlockedAttention(aargs, impl_choice=True))
-    g.then_finish(BlockedAttention(aargs, impl_choice=True))
+    op = BlockedAttention(aargs, impl_choice=True, fused_choice=True)
+    g.start_then(op)
+    g.then_finish(op)
     return g, bufs, metric_for("attn", args)
 
 
@@ -340,30 +341,46 @@ def main() -> int:
     # VERDICT r3 item 1)
     seed_paths = []
     if args.workload == "attn" and not args.smoke:
-        # kernel incumbent: the serialized order with every block choosing the
-        # bf16 Pallas kernel (double MXU throughput) — the likely winner the
-        # directed search should start from, and the final batch must include
+        # kernel incumbents: (a) the per-block chain with every block on the
+        # bf16 Pallas kernel (the r2-r4 winner), (b) the fused single-kernel
+        # flash with VMEM-resident state (the r5 HBM-state-traffic fix) —
+        # the directed search starts from both, the final batch must include
+        # whichever survives the screen
         from tenzing_tpu.core.state import ChooseOp
         from tenzing_tpu.solve.mcts.mcts import SimResult
 
-        st = State(g)
-        while not st.is_terminal():
-            ds = st.get_decisions(naive_plat)
-            pick = next(
-                (d for d in ds if isinstance(d, ChooseOp)
-                 and d.choice.name().endswith(".pallas_bf16")),
-                ds[0],
+        def attn_incumbent(label, engine_suffix, kernel_suffix):
+            st = State(g)
+            while not st.is_terminal():
+                ds = st.get_decisions(naive_plat)
+                pick = next(
+                    (d for d in ds if isinstance(d, ChooseOp)
+                     and d.choice.name().endswith(engine_suffix)),
+                    None,
+                ) or next(
+                    (d for d in ds if isinstance(d, ChooseOp)
+                     and d.choice.name().endswith(kernel_suffix)),
+                    ds[0],
+                )
+                st = st.apply(pick)
+            t0 = time.time()
+            try:
+                res_i = bench.benchmark(st.sequence, search_opts)
+            except Exception as e:
+                sys.stderr.write(
+                    f"{label} incumbent rejected ({type(e).__name__}: "
+                    f"{str(e)[:160]})\n")
+                return
+            sys.stderr.write(
+                f"{label} incumbent: pct50={res_i.pct50*1e6:.1f}us "
+                f"(wall {time.time()-t0:.0f}s)\n"
             )
-            st = st.apply(pick)
-        t0 = time.time()
-        bf16 = bench.benchmark(st.sequence, search_opts)
-        sys.stderr.write(
-            f"bf16-kernel incumbent: pct50={bf16.pct50*1e6:.1f}us "
-            f"(wall {time.time()-t0:.0f}s)\n"
-        )
-        sim = SimResult(order=st.sequence, result=bf16)
-        incumbent_labels[id(sim)] = "bf16-kernel"
-        incumbents.append(sim)
+            sim = SimResult(order=st.sequence, result=res_i)
+            incumbent_labels[id(sim)] = label
+            incumbents.append(sim)
+
+        attn_incumbent("bf16-kernel", ".chain", ".pallas_bf16")
+        attn_incumbent("fused-bf16", ".fused_bf16", ".pallas_bf16")
     if args.workload in ("halo", "moe"):
         from tenzing_tpu.solve.mcts.mcts import SimResult
 
@@ -493,9 +510,9 @@ def main() -> int:
     recorded = []  # best-first sequences, filled below
     if args.seed_csv is None:
         args.seed_csv = {
-            "halo": "experiments/halo_search_tpu_r4*.csv",
-            "moe": "experiments/moe_search_tpu_r4*.csv",
-            "attn": "experiments/attn_search_tpu_r4*.csv",
+            "halo": "experiments/halo_search_tpu_r[45]*.csv",
+            "moe": "experiments/moe_search_tpu_r[45]*.csv",
+            "attn": "experiments/attn_search_tpu_r[45]*.csv",
         }.get(args.workload, "")
     if args.seed_csv and args.seed_topk > 0 and not args.smoke:
         import glob as _glob
@@ -639,22 +656,7 @@ def main() -> int:
         return prefer, (max(lanes_used) + 1 if lanes_used else 2)
 
     if args.workload == "halo" and not args.smoke:
-        from tenzing_tpu.models.halo import DIRECTIONS, dir_name
-        from tenzing_tpu.models.halo_pipeline import HALO_PHASES, paired_priority
-
-        dirs = [dir_name(d) for d in DIRECTIONS]
-
-        def halo_prefer(op_name, choices):
-            if op_name.startswith("xfer_"):
-                i = dirs.index(op_name.split("_", 1)[1])
-                want = ".rdma" if i % 2 == 0 else ".host"
-                return next((c for c in choices if c.endswith(want)), None)
-            return next((c for c in choices if c.endswith(".xla")), None)
-
-        def rdma_prefer(op_name, choices):
-            if op_name.startswith("xfer_"):
-                return next((c for c in choices if c.endswith(".rdma")), None)
-            return next((c for c in choices if c.endswith(".xla")), None)
+        from tenzing_tpu.models.halo_pipeline import HALO_PHASES
 
         def alias_prefer(op_name, choices):
             # all-rdma + the aliased-unpack kernel map (the measured r5

@@ -63,14 +63,21 @@ def main() -> int:
             f"--workload {args.workload} graph ({len(db.skipped)} skipped) — "
             "workload/CSV mismatch?"
         )
-    # the optimum comes from the RAW pct50 column of every recorded row:
-    # rows recorded from a different graph shape (e.g. pre-choice incumbent
-    # schedules) may not deserialize for replay matching, but their TIMES are
-    # still the database's ground truth — the iterations-to-optimum signal
-    # must not silently improve because the best row was unmatchable
+    # the optimum comes from the RAW pct50 column of every FULL-fidelity
+    # recorded row: rows recorded from a different graph shape (e.g.
+    # pre-choice incumbent schedules) may not deserialize for replay
+    # matching, but their TIMES are still the database's ground truth — the
+    # iterations-to-optimum signal must not silently improve because the
+    # best row was unmatchable.  Multi-fidelity screen rows (``fid=screen``
+    # cell, round 5) are excluded on BOTH sides: their ~1 ms-floor pct50s
+    # are off-regime bookkeeping, and CsvBenchmarker already refuses to
+    # answer queries from them.
     def row_pct50(line):
         parts = line.split("|")
         try:
+            if len(parts) > 7 and parts[7].startswith("fid=") \
+                    and parts[7] != "fid=full":
+                return float("inf")
             return float(parts[3])
         except (IndexError, ValueError):  # truncated/malformed row: skip,
             return float("inf")           # like the strict=False loader
@@ -89,7 +96,11 @@ def main() -> int:
 
         def __init__(self, inner):
             self.inner = inner
-            self.worst = max((r for _, r in inner.entries), key=lambda r: r.pct50)
+            # worst over FULL-fidelity rows only (screen rows are off-regime
+            # and excluded from the lookup cache anyway)
+            full = [r for (_, r), f in zip(inner.entries, inner.fidelities)
+                    if f == "full"] or [r for _, r in inner.entries]
+            self.worst = max(full, key=lambda r: r.pct50)
             self.misses = 0
 
         def benchmark(self, order, opts=None):

@@ -100,24 +100,35 @@ def attn_entry():
     bufs, want = make_blocked_buffers(aargs, seed=0)
     jbufs = {k: jnp.asarray(v) for k, v in bufs.items()}
     g = Graph()
-    g.start_then(BlockedAttention(aargs, impl_choice=True))
-    g.then_finish(BlockedAttention(aargs, impl_choice=True))
+    op = BlockedAttention(aargs, impl_choice=True, fused_choice=True)
+    g.start_then(op)
+    g.then_finish(op)
     plat = Platform.make_n_lanes(2)
     ex = TraceExecutor(plat, jbufs)
 
-    # our winner: every block through the bf16 Pallas MXU kernel (the searched
-    # optimum of BENCH r2's kernel menu), serialized — the blocks chain through
-    # the softmax state so lanes add nothing here
-    st = State(g)
-    while not st.is_terminal():
-        ds = st.get_decisions(plat)
-        pick = next(
-            (d for d in ds if isinstance(d, ChooseOp)
-             and d.choice.name().endswith(".pallas_bf16")),
-            ds[0],
-        )
-        st = st.apply(pick)
-    ours_prog = ex.compile(st.sequence)
+    def schedule_for(engine_suffix, kernel_suffix):
+        st = State(g)
+        while not st.is_terminal():
+            ds = st.get_decisions(plat)
+            pick = next(
+                (d for d in ds if isinstance(d, ChooseOp)
+                 and d.choice.name().endswith(engine_suffix)),
+                None,
+            ) or next(
+                (d for d in ds if isinstance(d, ChooseOp)
+                 and d.choice.name().endswith(kernel_suffix)),
+                ds[0],
+            )
+            st = st.apply(pick)
+        return st.sequence
+
+    # our two menu optima: (a) per-block chain, every block on the bf16
+    # Pallas MXU kernel (the r2-r4 winner); (b) the fused single-kernel
+    # flash with VMEM-resident softmax state (the r5 HBM-traffic fix)
+    seq_chain = schedule_for(".chain", ".pallas_bf16")
+    seq_fused = schedule_for(".fused_bf16", ".pallas_bf16")
+    ours_prog = ex.compile(seq_chain)
+    fused_prog = ex.compile(seq_fused)
 
     b, n, d = aargs.batch, aargs.seq_local * aargs.n_devices, aargs.head_dim
     q4 = jbufs["Q"].reshape(b, n, 1, d)
@@ -134,11 +145,35 @@ def attn_entry():
     sys.stderr.write("attn: numerics check...\n")
     o_ours = np.asarray(ours_prog(jbufs)["O"])
     np.testing.assert_allclose(o_ours, want, atol=0.05)
+    o_fused = np.asarray(fused_prog(jbufs)["O"])
+    np.testing.assert_allclose(o_fused, want, atol=0.05)
     sys.stderr.write("attn: numerics ok; measuring...\n")
+    # CONTROL for the bf16 anomaly (VERDICT r4 item 3): a hand-written f32
+    # attention that MATERIALIZES the (n, n) score matrix.  Measured (r5):
+    # compiled memory analysis shows NEITHER precision gets a flash lowering
+    # from XLA on this backend — f32 dot_product_attention materializes one
+    # 1.074 GB n^2 temp (and times identically to this hand-written
+    # materializing control, 4.70 vs 4.71 ms), while the bf16 lowering
+    # allocates TWO n^2 temps (2.148 GB) and runs ~23x slower than its f32
+    # twin at ~0.3% of HBM peak — a degenerate bf16 lowering (giant-tensor
+    # relayout/conversion passes), not bf16 arithmetic (an f32-softmax bf16
+    # variant is equally slow).  The searched Pallas menu is the only flash
+    # path measured on this chip.
+    def materializing_f32(q, k, v):
+        import jax.numpy as _jnp
+
+        s = _jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=_jnp.float32) * aargs.scale
+        p = jax.nn.softmax(s, axis=-1)
+        return _jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                           preferred_element_type=_jnp.float32)
+
     fns = {
-        "searched_bf16_menu": ex.prepare_n(st.sequence),
+        "searched_bf16_menu": ex.prepare_n(seq_chain),
+        "searched_fused_bf16": ex.prepare_n(seq_fused),
         "xla_fused_f32": repeat_fenced(fused, q4, k4, v4),
         "xla_fused_bf16": repeat_fenced(fused, qb, kb, vb),
+        "xla_materializing_f32": repeat_fenced(materializing_f32, q4, k4, v4),
     }
     times, results = measure_set(fns)
     # bytes/element per entry: the fused-bf16 baseline's Q/K/V really are
@@ -147,8 +182,10 @@ def attn_entry():
     # one), so its HBM cost stays f32
     costs = {
         "searched_bf16_menu": attention_cost(b, n, d, bytes_per_el=4),
+        "searched_fused_bf16": attention_cost(b, n, d, bytes_per_el=4),
         "xla_fused_f32": attention_cost(b, n, d, bytes_per_el=4),
         "xla_fused_bf16": attention_cost(b, n, d, bytes_per_el=2),
+        "xla_materializing_f32": attention_cost(b, n, d, bytes_per_el=4),
     }
     entry = {"workload": "blocked_attention", "config": {"b": b, "n": n, "d": d}}
     for name, res in results.items():
@@ -157,10 +194,40 @@ def attn_entry():
             **{k: round(v, 4)
                for k, v in costs[name].utilization(res.pct50).items()},
         }
+    # the bf16 "fused" row is a degenerate lowering, not a fair baseline:
+    # flag it so no one quotes a paired ratio against it (the control row
+    # proves the cause — materializing f32 costs the same)
+    entry["xla_fused_bf16"]["anomalous_baseline"] = True
+    entry["xla_fused_bf16"]["cause"] = (
+        "degenerate XLA bf16 lowering: memory analysis shows 2.148 GB of "
+        "n^2 temps (two score-matrix copies) vs the f32 lowering's "
+        "1.074 GB, running ~23x slower than the f32 twin at ~0.3% of HBM "
+        "peak; not bf16 arithmetic (f32-softmax variant equally slow) and "
+        "not flash-vs-materializing (neither XLA lowering is flash — the "
+        "f32 path times identically to the materializing control)"
+    )
+    ours_best = min(("searched_bf16_menu", "searched_fused_bf16"),
+                    key=lambda nm: results[nm].pct50)
+    entry["ours_best"] = ours_best
+    entry["mfu_ceiling_note"] = (
+        "the fused single-kernel variant (attn_fused_pallas, VMEM-resident "
+        "state, removes ~0.8 GB/iter of acc/m/l HBM round trips) measures "
+        "within a few % of the chain — HBM state traffic is NOT the binding "
+        "constraint; the remaining gap to peak is the in-kernel "
+        "s->softmax->PV dependency chain (MXU idles during the VPU exp over "
+        "each n*nkv score tile; Mosaic does not software-pipeline the "
+        "independent QK^T(t+1) into that window). Closing it needs "
+        "cross-step software pipelining inside the kernel, not block-size "
+        "tuning (probed: fused bkv=1024 changes nothing)."
+    )
     for name in ("xla_fused_f32", "xla_fused_bf16"):
-        m, lo, hi = paired_speedup(times[name], times["searched_bf16_menu"], seed=5)
+        m, lo, hi = paired_speedup(times[name], times[ours_best], seed=5)
         entry[f"ours_vs_{name}"] = {"paired": round(m, 4),
                                     "ci": [round(lo, 4), round(hi, 4)]}
+    entry["ours_vs_xla_fused_bf16"]["do_not_quote"] = (
+        "denominator is the anomalous non-flash lowering; quote "
+        "ours_vs_xla_fused_f32 instead"
+    )
     return entry
 
 
@@ -244,6 +311,16 @@ def moe_entry():
         times["xla_single_jit"], times["searched_bf16_staged"], seed=5)
     entry["ours_vs_xla_single_jit"] = {"paired": round(m, 4),
                                        "ci": [round(lo, 4), round(hi, 4)]}
+    # label the comparison honestly (VERDICT r4 weak #7): this row measures
+    # the STAGED pipeline variant (host-staged dispatch/combine hops) against
+    # the no-hop single-jit upper bound — a diagnostic of the staging tax,
+    # NOT the searched winner.  The driver's searched winner (BENCH moe runs)
+    # is the kernel-menu schedule BASELINE.md quotes at within ~8% of
+    # single-jit.
+    entry["ours_vs_xla_single_jit"]["diagnostic_row"] = (
+        "staged-variant vs no-hop upper bound; not the searched winner — "
+        "see BENCH moe runs for the headline schedule"
+    )
     return entry
 
 

@@ -29,8 +29,10 @@ Machine model (the executor's token-lane semantics, abstracted):
 
 Costs derive from buffer byte sizes (a ``{name: nbytes}`` map, typically
 built from the actual buffer dict) — per-op special-casing lives in the
-``cost_fn`` hook, not here.  Defaults are TPU v5p-class: 819 GB/s HBM, 90
-GB/s/link ICI, 1 us hop latency, 30 GB/s PCIe-class host path.
+``cost_fn`` hook, not here.  Defaults are TPU v5e single-chip figures
+(819 GB/s HBM, 197 TFLOP/s bf16 — bench/roofline.py) with a v5p-class
+90 GB/s/link ICI, 1 us hop latency, and a 30 GB/s PCIe-class host path;
+override via ``ModelEnv`` for other generations.
 """
 
 from __future__ import annotations

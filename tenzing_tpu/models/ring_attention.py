@@ -286,20 +286,60 @@ class BlockAttnChoice(ChoiceOp):
         ]
 
 
-class BlockedAttention(CompoundOp):
-    """Single-device blockwise attention over ``n_blocks`` K/V blocks: the attn
-    steps chain through the softmax state; block loads overlap on lanes; the
-    per-step kernel is a ChoiceOp when ``impl_choice``.  ``args.n_devices``
-    is reused as the block count (no mesh involved)."""
+class FusedBlockAttn(DeviceOp):
+    """ALL K/V blocks folded in one fused Pallas flash kernel
+    (ops/attention_pallas.attn_fused_pallas): the online-softmax state lives
+    in VMEM scratch across the kv grid dimension instead of round-tripping
+    HBM between per-block ops.  Measured motivation (r5): the chained
+    variant moves ~0.8 GB of acc/m/l state per iteration at the bench config
+    (b=4, n=8k, d=128) — HBM-state-bound at 66.5% MFU; fusing removes
+    6 x 16.8 MB of traffic per block."""
 
-    def __init__(self, args: RingAttnArgs, name: str = "blocked_attention",
-                 impl_choice: bool = False):
+    BF16 = False
+
+    def __init__(self, name: str, args: RingAttnArgs):
+        super().__init__(name)
+        self._args = args
+
+    def reads(self):
+        return ["Q", "K", "V", "acc", "m_run", "l_run"]
+
+    def writes(self):
+        return ["acc", "m_run", "l_run"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        from tenzing_tpu.ops.attention_pallas import attn_fused_pallas
+
+        q, k, v = bufs["Q"], bufs["K"], bufs["V"]
+        if self.BF16:
+            bf = jnp.bfloat16
+            q, k, v = q.astype(bf), k.astype(bf), v.astype(bf)
+        acc, m, l = attn_fused_pallas(
+            q, k, v, bufs["acc"], bufs["m_run"], bufs["l_run"],
+            self._args.scale, bkv=self._args.seq_local,
+        )
+        return {"acc": acc, "m_run": m, "l_run": l}
+
+    def uses_pallas(self) -> bool:
+        return True
+
+
+class FusedBlockAttnBf16(FusedBlockAttn):
+    BF16 = True
+
+
+class BlockChain(CompoundOp):
+    """The per-block fold chain as one expandable vertex — the staged
+    alternative the fused kernel competes with inside
+    :class:`AttnEngineChoice` (the HostRoundTrip-in-TransferChoice
+    precedent, models/halo_pipeline.py)."""
+
+    def __init__(self, name: str, args: RingAttnArgs, impl_choice: bool):
         super().__init__(name)
         self._args = args
         self._impl_choice = impl_choice
-
-    def args(self) -> RingAttnArgs:
-        return self._args
 
     def graph(self) -> Graph:
         g = Graph()
@@ -309,8 +349,62 @@ class BlockedAttention(CompoundOp):
         g.start_then(attns[0])
         for s in range(1, n):
             g.then(attns[s - 1], attns[s])
+        g.then_finish(attns[-1])
+        return g
+
+
+class AttnEngineChoice(ChoiceOp):
+    """Granularity menu for the whole blocked fold: the per-block chain
+    (searchable order x lane x per-block kernel) vs the fused single-kernel
+    flash (f32 or bf16 MXU inputs) — kernel granularity is itself a
+    scheduling decision the solver owns."""
+
+    def __init__(self, args: RingAttnArgs, impl_choice: bool):
+        super().__init__("attn_blocks")
+        self._args = args
+        self._impl_choice = impl_choice
+
+    def choices(self) -> List[OpBase]:
+        return [
+            BlockChain("attn_blocks.chain", self._args, self._impl_choice),
+            FusedBlockAttn("attn_blocks.fused", self._args),
+            FusedBlockAttnBf16("attn_blocks.fused_bf16", self._args),
+        ]
+
+
+class BlockedAttention(CompoundOp):
+    """Single-device blockwise attention over ``n_blocks`` K/V blocks: the attn
+    steps chain through the softmax state; block loads overlap on lanes; the
+    per-step kernel is a ChoiceOp when ``impl_choice``; with ``fused_choice``
+    the whole chain additionally competes with the fused single-kernel flash
+    (:class:`AttnEngineChoice`).  ``args.n_devices`` is reused as the block
+    count (no mesh involved)."""
+
+    def __init__(self, args: RingAttnArgs, name: str = "blocked_attention",
+                 impl_choice: bool = False, fused_choice: bool = False):
+        super().__init__(name)
+        self._args = args
+        self._impl_choice = impl_choice
+        self._fused_choice = fused_choice
+
+    def args(self) -> RingAttnArgs:
+        return self._args
+
+    def graph(self) -> Graph:
+        g = Graph()
+        n = self._args.n_devices
         fin = FinalizeAttn()
-        g.then(attns[-1], fin)
+        if self._fused_choice:
+            eng = AttnEngineChoice(self._args, self._impl_choice)
+            g.start_then(eng)
+            g.then(eng, fin)
+        else:
+            mk = BlockAttnChoice if self._impl_choice else BlockAttnStep
+            attns = [mk(f"attn_{s}", s, self._args) for s in range(n)]
+            g.start_then(attns[0])
+            for s in range(1, n):
+                g.then(attns[s - 1], attns[s])
+            g.then(attns[-1], fin)
         g.then_finish(fin)
         return g
 

@@ -133,3 +133,70 @@ class TestNumerics:
         np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
         out = ex.run(bf16[0].sequence)
         np.testing.assert_allclose(np.asarray(out["O"]), want, rtol=3e-2, atol=3e-2)
+
+
+class TestFusedBlockAttn:
+    def test_fused_engine_choice_matches(self):
+        """The fused single-kernel flash alternatives (AttnEngineChoice)
+        compute the same O as the per-block chain and the dense host
+        reference (interpret mode)."""
+        from tenzing_tpu.models.ring_attention import (
+            BlockedAttention,
+            make_blocked_buffers,
+        )
+        from tenzing_tpu.solve.dfs import enumerate_schedules
+
+        args = RingAttnArgs(n_devices=4, batch=2, seq_local=8, head_dim=8)
+        bufs, want = make_blocked_buffers(args, seed=5)
+        plat = Platform.make_n_lanes(2)
+        g = Graph()
+        op = BlockedAttention(args, impl_choice=True, fused_choice=True)
+        g.start_then(op)
+        g.then_finish(op)
+        # 3^4 per-block chain variants enumerate before the 2 fused
+        # structural variants — the budget must cover all 83
+        seqs = enumerate_schedules(g, plat, max_seqs=128)
+        fused = [s for s in seqs
+                 if _has_kind(s, ".fused") and not _has_kind(s, ".fused_bf16")]
+        fused_bf16 = [s for s in seqs if _has_kind(s, ".fused_bf16")]
+        chain = [s for s in seqs
+                 if any(op.name().startswith("attn_0.") for op in s.sequence)]
+        assert fused and fused_bf16 and chain
+        ex = TraceExecutor(plat, {k: jnp.asarray(v) for k, v in bufs.items()})
+        for s in (fused[0], chain[0]):
+            out = ex.run(s.sequence)
+            np.testing.assert_allclose(
+                np.asarray(out["O"]), want, rtol=2e-4, atol=2e-5)
+        out = ex.run(fused_bf16[0].sequence)
+        np.testing.assert_allclose(
+            np.asarray(out["O"]), want, rtol=3e-2, atol=3e-2)
+
+    def test_fused_kernel_equals_chained_kernel(self):
+        """attn_fused_pallas == chained attn_block_pallas on the same state
+        (ragged n exercises the q-tile padding path)."""
+        from tenzing_tpu.ops.attention_pallas import (
+            attn_block_pallas,
+            attn_fused_pallas,
+        )
+
+        b, n, d, nkv, bkv = 1, 24, 16, 64, 16
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.standard_normal((b, n, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, nkv, d)), jnp.float32)
+        acc = jnp.zeros((b, n, d), jnp.float32)
+        m = jnp.full((b, n, d), -1e30, jnp.float32)
+        l = jnp.zeros((b, n, d), jnp.float32)
+        scale = 1 / np.sqrt(d)
+        a1, m1, l1 = acc, m, l
+        for s in range(nkv // bkv):
+            a1, m1, l1 = attn_block_pallas(
+                q, k[:, s * bkv:(s + 1) * bkv], v[:, s * bkv:(s + 1) * bkv],
+                a1, m1, l1, scale)
+        a2, m2, l2 = attn_fused_pallas(q, k, v, acc, m, l, scale, bkv=bkv)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=2e-5, atol=2e-5)
