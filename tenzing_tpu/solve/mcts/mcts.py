@@ -50,6 +50,12 @@ class MctsOpts:
     # item 2: 40 rollouts in 93 s was 99.8% BENCHMARK)
     screen_opts: Optional[BenchOpts] = None
     confirm_topk: int = 6
+    # informed playouts (Node.get_rollout): complete each rollout with this
+    # ``(state, decisions) -> decision`` policy instead of uniform random,
+    # taking a random decision with probability ``rollout_eps`` per step.
+    # None = the reference's uniform-random playout.
+    rollout_policy: Optional[object] = None
+    rollout_eps: float = 0.15
     expand_rollout: bool = False
     dump_tree: bool = False
     dump_tree_prefix: str = "mcts_tree"
@@ -201,8 +207,12 @@ def explore(
                 if path is not None:
                     with counters.phase("SEED"):
                         endpoint, st = _materialize_seed(root, path)
-                        if not st.is_terminal():  # defensive: complete randomly
-                            _, order = endpoint.get_rollout(platform, rng)
+                        if not st.is_terminal():  # defensive: complete
+                            _, order = endpoint.get_rollout(
+                                platform, rng,
+                                policy=opts.rollout_policy,
+                                policy_eps=opts.rollout_eps,
+                            )
                         else:
                             # benchmarked AS RECORDED (no redundant-sync
                             # cleanup): the cache key matches the incumbent's
@@ -219,7 +229,9 @@ def explore(
                         child = leaf.expand(platform, rng)
                     with counters.phase("ROLLOUT"):
                         endpoint, order = child.get_rollout(
-                            platform, rng, opts.expand_rollout
+                            platform, rng, opts.expand_rollout,
+                            policy=opts.rollout_policy,
+                            policy_eps=opts.rollout_eps,
                         )
                     with counters.phase("REDUNDANT_SYNC"):
                         order = remove_redundant_syncs(order)
