@@ -37,7 +37,11 @@ def main() -> int:
     args = ap.parse_args()
     _driver.setup(args)
 
-    from tenzing_tpu.bench.benchmarker import BenchOpts, CsvBenchmarker
+    from tenzing_tpu.bench.benchmarker import (
+        BenchOpts,
+        CsvBenchmarker,
+        split_fidelity,
+    )
     from tenzing_tpu.core.graph import Graph
     from tenzing_tpu.core.platform import Platform
     from tenzing_tpu.solve.mcts import MctsOpts, explore, strategies
@@ -75,8 +79,7 @@ def main() -> int:
     def row_pct50(line):
         parts = line.split("|")
         try:
-            if len(parts) > 7 and parts[7].startswith("fid=") \
-                    and parts[7] != "fid=full":
+            if split_fidelity(parts)[0] != "full":
                 return float("inf")
             return float(parts[3])
         except (IndexError, ValueError):  # truncated/malformed row: skip,

@@ -24,9 +24,14 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from pathlib import Path
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tenzing_tpu.bench.benchmarker import split_fidelity  # noqa: E402
 
 DELIM = "|"
 
@@ -38,15 +43,26 @@ def load_rows(text: str) -> List[dict]:
         if not line.strip():
             continue
         cells = line.split(DELIM)
-        times = {
-            "pct01": float(cells[1]),
-            "pct10": float(cells[2]),
-            "pct50": float(cells[3]),
-            "pct90": float(cells[4]),
-            "pct99": float(cells[5]),
-            "stddev": float(cells[6]),
-        }
-        ops = [json.loads(c) for c in cells[7:]]
+        try:
+            times = {
+                "pct01": float(cells[1]),
+                "pct10": float(cells[2]),
+                "pct50": float(cells[3]),
+                "pct90": float(cells[4]),
+                "pct99": float(cells[5]),
+                "stddev": float(cells[6]),
+            }
+            # multi-fidelity dumps (round 5): screen rows were measured at
+            # a ~1 ms floor and would smear the class boundaries — excluded
+            # via the one shared parsing rule
+            fid, ops_at = split_fidelity(cells)
+            if fid != "full":
+                continue
+            ops = [json.loads(c) for c in cells[ops_at:]]
+        except (IndexError, ValueError):
+            # truncated/malformed row (e.g. a dump cut mid-write): skip it,
+            # like CsvBenchmarker's strict=False loader
+            continue
         out.append({"times": times, "ops": ops})
     return out
 

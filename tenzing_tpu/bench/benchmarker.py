@@ -331,6 +331,17 @@ class CachingBenchmarker:
 CSV_DELIM = "|"
 
 
+def split_fidelity(cells: List[str]) -> Tuple[str, int]:
+    """(fidelity, ops_start_index) of a split CSV row — THE parsing rule for
+    the optional ``fid=<tag>`` cell between the stats and the ops (legacy
+    rows have none and are "full").  Every reader of the dump format
+    (CsvBenchmarker, postprocess, replay) must use this one definition so
+    they cannot drift on which rows count as full-fidelity."""
+    if len(cells) > 7 and cells[7].startswith("fid="):
+        return cells[7][4:], 8
+    return "full", 7
+
+
 def result_row(idx: int, res: BenchResult, order: Sequence,
                fidelity: Optional[str] = None) -> str:
     """One CSV row: ``idx|pct01|pct10|pct50|pct90|pct99|stddev|op-json|...``
@@ -400,12 +411,7 @@ class CsvBenchmarker:
                     pct99=float(cells[5]),
                     stddev=float(cells[6]),
                 )
-                # optional fidelity cell ("fid=screen") before the ops —
-                # absent in legacy rows, which start the ops at cells[7]
-                ops_at, fid = 7, "full"
-                if len(cells) > 7 and cells[7].startswith("fid="):
-                    fid = cells[7][4:]
-                    ops_at = 8
+                fid, ops_at = split_fidelity(cells)
                 ops = [op_from_json(json.loads(c), graph) for c in cells[ops_at:]]
             except (KeyError, TypeError, ValueError, IndexError):
                 # malformed row (e.g. dump truncated mid-write) or ops recorded

@@ -133,3 +133,22 @@ def test_example_moe_mcts_smoke():
     )
     assert p.returncode == 0, p.stderr
     assert p.stdout.strip()
+
+
+def test_postprocess_excludes_screen_fidelity_rows():
+    """load_rows keeps legacy + fid=full rows and drops fid=screen rows —
+    the shared split_fidelity rule (bench.benchmarker) applied to the
+    offline analysis."""
+    import json as _json
+
+    from postprocess.postprocess import load_rows
+
+    op = _json.dumps({"kind": "device", "name": "a", "lane": 0})
+    rows = "\n".join([
+        "0|1.0|1.0|1.0|1.0|1.0|0.0|" + op,                  # legacy = full
+        "1|2.0|2.0|2.0|2.0|2.0|0.0|fid=screen|" + op,       # dropped
+        "2|3.0|3.0|3.0|3.0|3.0|0.0|fid=full|" + op,         # kept
+    ])
+    out = load_rows(rows)
+    assert [r["times"]["pct50"] for r in out] == [1.0, 3.0]
+    assert all(r["ops"] == [_json.loads(op)] for r in out)
