@@ -221,7 +221,10 @@ def main() -> int:
     ap.add_argument("--halo-n", type=int, default=512, help="cells per side (halo)")
     ap.add_argument("--lanes", type=int, default=None,
                     help="search-platform lanes (default: 8 for halo, else 2)")
-    ap.add_argument("--mcts-iters", type=int, default=40, help="MCTS iterations (compile budget)")
+    # raised 40 -> 56 in r5: informed playouts (rollout_policy) made MCTS a
+    # producing solver (the r5c winner was a rollout), and the multi-fidelity
+    # screen floor keeps the marginal iteration cheap (~2-3 s)
+    ap.add_argument("--mcts-iters", type=int, default=56, help="MCTS iterations (compile budget)")
     ap.add_argument("--iters", type=int, default=20, help="measurements per schedule (screen/final)")
     ap.add_argument("--search-iters", type=int, default=6,
                     help="measurements per schedule during MCTS (cheap phase)")
