@@ -231,6 +231,14 @@ def main() -> int:
     ap.add_argument("--climb-budget", type=int, default=44,
                     help="hill-climb benchmark budget after MCTS")
     ap.add_argument("--dump-csv", default=None, help="write searched results as CSV rows")
+    ap.add_argument("--trace-out", default=None,
+                    help="directory for the telemetry bundle: trace.jsonl "
+                         "(machine) + trace.json (Chrome trace-event, load "
+                         "in Perfetto); enables span tracing")
+    ap.add_argument("--metrics-json", default=None,
+                    help="write the metrics registry (solver phase timings, "
+                         "benchmark cache hit rate, measurement counts) as "
+                         "JSON to this path")
     ap.add_argument("--seed-csv", default=None,
                     help="glob of recorded search CSVs; their best distinct "
                          "schedules are warm-start candidates and a climb "
@@ -249,11 +257,58 @@ def main() -> int:
 
     enable_compile_cache()
 
+    from tenzing_tpu import obs
+
+    if args.trace_out:
+        obs.configure(enabled=True)
+
+    _telemetry_done = {"v": False}
+
+    def write_telemetry():
+        """Archive the telemetry bundle once.  Registered with atexit (for
+        crashes: the interpreter still exits normally after an unhandled
+        exception) AND with utils.trap (for SIGINT/SIGABRT: the trap handler
+        re-raises via SIG_DFL, which kills the process without running
+        atexit) so an interrupted search — the run where the trace matters
+        most — still archives everything recorded so far.  The explicit call
+        on the success path just makes the files land before the final JSON
+        line.  Filenames are rank-qualified past rank 0 so multi-host runs
+        writing to a shared directory do not clobber each other's bundles."""
+        import os
+
+        if _telemetry_done["v"]:
+            return
+        _telemetry_done["v"] = True
+        rank = obs.get_tracer().rank
+        sfx = "" if rank == 0 else f".rank{rank}"
+        if args.trace_out:
+            os.makedirs(args.trace_out, exist_ok=True)
+            obs.write_jsonl(obs.get_tracer(),
+                            os.path.join(args.trace_out, f"trace{sfx}.jsonl"))
+            obs.write_chrome_trace(
+                obs.get_tracer(),
+                os.path.join(args.trace_out, f"trace{sfx}.json"))
+            sys.stderr.write(f"trace bundle: {args.trace_out}\n")
+        if args.metrics_json:
+            with open(args.metrics_json + sfx, "w") as f:
+                json.dump(obs.get_metrics().to_json(), f, indent=2,
+                          sort_keys=True)
+            sys.stderr.write(f"metrics: {args.metrics_json}{sfx}\n")
+
+    if args.trace_out or args.metrics_json:
+        import atexit
+
+        from tenzing_tpu.utils import trap
+
+        atexit.register(write_telemetry)
+        trap.register_handler(write_telemetry)
+
     metric_name = metric_for(args.workload, args)
     try:
         devs = probe_backend()
         sys.stderr.write(f"backend: {devs}\n")
     except Exception as e:  # still emit a parseable line (VERDICT r1 item 1)
+        write_telemetry()
         print(
             json.dumps(
                 {
@@ -992,6 +1047,7 @@ def main() -> int:
                          if top and finals and vs > 1.0 else None),
         "recorded_seeds": len(recorded),
     }
+    write_telemetry()
     print(
         json.dumps(
             {

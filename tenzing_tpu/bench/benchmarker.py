@@ -36,8 +36,27 @@ from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from tenzing_tpu.bench.randomness import is_random
 from tenzing_tpu.core.sequence import Sequence, canonical_key
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs.tracer import get_tracer, short_digest
 from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
 from tenzing_tpu.utils.numeric import percentile, stddev
+
+
+def schedule_id(order) -> str:
+    """Short stable id of a schedule for telemetry correlation:
+    ``obs.tracer.short_digest`` of its serialized form (works for Sequence
+    orders and the CallableRunner's plain string names alike).  Deterministic
+    across processes — multi-host trace bundles and archived JSONL agree on
+    ids without coordination."""
+    if isinstance(order, str):
+        return order
+    try:
+        from tenzing_tpu.core.serdes import sequence_to_json_str
+
+        payload = sequence_to_json_str(order)
+    except Exception:
+        payload = repr(order)
+    return short_digest(payload)
 
 
 @dataclass
@@ -51,6 +70,16 @@ class BenchResult:
     pct90: float = 0.0
     pct99: float = 0.0
     stddev: float = 0.0
+    # provenance for offline re-derivation (ISSUE 1 satellite): the raw
+    # per-sample series the percentiles were computed from, and the
+    # calibrated fetch-overhead correction the empirical benchmarker
+    # subtracted per measurement.  Excluded from equality/repr: two results
+    # are "the same measurement" by their statistics, and replayed results
+    # (CsvBenchmarker) legitimately carry no raw series.
+    times: Optional[List[float]] = field(default=None, compare=False,
+                                         repr=False)
+    fetch_overhead: Optional[float] = field(default=None, compare=False,
+                                            repr=False)
 
     @staticmethod
     def from_times(times: List[float]) -> "BenchResult":
@@ -62,10 +91,11 @@ class BenchResult:
             pct90=percentile(s, 90),
             pct99=percentile(s, 99),
             stddev=stddev(s),
+            times=list(times),
         )
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "pct01": self.pct01,
             "pct10": self.pct10,
             "pct50": self.pct50,
@@ -73,6 +103,11 @@ class BenchResult:
             "pct99": self.pct99,
             "stddev": self.stddev,
         }
+        if self.times is not None:
+            out["times"] = list(self.times)
+        if self.fetch_overhead is not None:
+            out["fetch_overhead"] = self.fetch_overhead
+        return out
 
 
 @dataclass
@@ -182,17 +217,33 @@ class EmpiricalBenchmarker:
     # reference benchmark(), benchmarker.cpp:121-167
     def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
         opts = opts if opts is not None else BenchOpts()
-        run_n, fences = self._runner_for(order)
-        run_n(1)  # warmup: compile + first dispatch excluded from timing
-        n_samples = 1
-        for attempt in range(opts.max_retries):
-            times: List[float] = []
-            for _ in range(opts.n_iters):
-                # _measure already max-reduces each elapsed across hosts
-                t, n_samples = self._measure(run_n, n_samples, opts, fences)
-                times.append(t)
-            if is_random(times) or attempt == opts.max_retries - 1:
-                return BenchResult.from_times(times)
+        tr = get_tracer()
+        sid = schedule_id(order) if tr.enabled else None
+        with tr.span("bench.benchmark", schedule=sid, n_iters=opts.n_iters,
+                     target_secs=opts.target_secs) as sp:
+            run_n, fences = self._runner_for(order)
+            with tr.span("bench.warm", schedule=sid):
+                run_n(1)  # warmup: compile + first dispatch excluded
+            n_samples = 1
+            for attempt in range(opts.max_retries):
+                times: List[float] = []
+                for _ in range(opts.n_iters):
+                    # _measure already max-reduces each elapsed across hosts
+                    t, n_samples = self._measure(run_n, n_samples, opts, fences)
+                    times.append(t)
+                if is_random(times) or attempt == opts.max_retries - 1:
+                    res = BenchResult.from_times(times)
+                    res.fetch_overhead = self._overhead
+                    sp.set("pct50", res.pct50)
+                    sp.set("n_samples", n_samples)
+                    sp.set("fetch_overhead", self._overhead)
+                    sp.set("attempts", attempt + 1)
+                    reg = get_metrics()
+                    reg.counter("bench.benchmarks").inc()
+                    reg.counter("bench.measurements").inc(len(times))
+                    if attempt:
+                        reg.counter("bench.runs_test_retries").inc(attempt)
+                    return res
         raise AssertionError("unreachable")  # pragma: no cover
 
     # reference batch benchmark(), benchmarker.cpp:21-76: measure a SET of
@@ -223,20 +274,27 @@ class EmpiricalBenchmarker:
             len(times_out) != len(orders) or any(ts for ts in times_out)
         ):
             raise ValueError("times_out must have one EMPTY list per order")
-        runners = [self._runner_for(o) for o in orders]
-        for r, _ in runners:
-            r(1)  # warmup/compile all before timing any
-        n_samples = [1] * len(orders)
-        times: List[List[float]] = (
-            times_out if times_out is not None else [[] for _ in orders]
-        )
-        for _ in range(opts.n_iters):
-            perm = list(range(len(orders)))
-            rng.shuffle(perm)  # seeded: identical visit order on every host
-            for i in perm:
-                run_n, fences = runners[i]
-                t, n_samples[i] = self._measure(run_n, n_samples[i], opts, fences)
-                times[i].append(t)
+        tr = get_tracer()
+        with tr.span("bench.batch", n_orders=len(orders),
+                     n_iters=opts.n_iters, seed=seed) as sp:
+            runners = [self._runner_for(o) for o in orders]
+            with tr.span("bench.batch_warm", n_orders=len(orders)):
+                for r, _ in runners:
+                    r(1)  # warmup/compile all before timing any
+            n_samples = [1] * len(orders)
+            times: List[List[float]] = (
+                times_out if times_out is not None else [[] for _ in orders]
+            )
+            for _ in range(opts.n_iters):
+                perm = list(range(len(orders)))
+                rng.shuffle(perm)  # seeded: identical visit order on every host
+                for i in perm:
+                    run_n, fences = runners[i]
+                    t, n_samples[i] = self._measure(run_n, n_samples[i], opts, fences)
+                    times[i].append(t)
+            sp.set("fetch_overhead", self._overhead)
+            get_metrics().counter("bench.measurements").inc(
+                opts.n_iters * len(orders))
         return times
 
     def benchmark_batch(
@@ -315,14 +373,29 @@ class CachingBenchmarker:
         ok = (opts.n_iters, opts.max_retries, opts.target_secs) if opts else None
         return (ok, canonical_key(order))
 
+    @property
+    def hit_rate(self) -> float:
+        """Fraction of queries answered from the cache (0.0 when unqueried)."""
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
     def benchmark(self, order: Sequence, opts: Optional[BenchOpts] = None) -> BenchResult:
         key = self._key(order, opts)
-        if key in self._cache:
+        hit = key in self._cache
+        if hit:
             self.hits += 1
-            return self._cache[key]
-        res = self.inner.benchmark(order, opts)
-        self._cache[key] = res
-        self.misses += 1
+            res = self._cache[key]
+        else:
+            res = self.inner.benchmark(order, opts)
+            self._cache[key] = res
+            self.misses += 1
+        reg = get_metrics()
+        reg.counter("bench.cache.hits" if hit else "bench.cache.misses").inc()
+        reg.gauge("bench.cache.hit_rate").set(self.hit_rate)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event("bench.cache", hit=hit, schedule=schedule_id(order),
+                     pct50=res.pct50)
         return res
 
 

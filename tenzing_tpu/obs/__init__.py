@@ -1,0 +1,59 @@
+"""Unified telemetry: span/event tracing, metrics, progress reporting, export.
+
+The observability layer the whole decision loop reports through (ISSUE 1):
+
+* :mod:`tenzing_tpu.obs.tracer` — nested spans + instant events, thread-safe,
+  near-zero overhead when disabled; every record is tagged with the control
+  plane's rank so multi-host traces merge in one timeline.
+* :mod:`tenzing_tpu.obs.metrics` — counters / gauges / histograms with
+  percentile summaries; subsumes ``utils/counters.py`` (kept as a shim).
+* :mod:`tenzing_tpu.obs.progress` — human-readable progress lines that also
+  flow into the tracer's event stream, replacing raw ``print()`` in library
+  code (enforced by tests/test_no_print.py).
+* :mod:`tenzing_tpu.obs.export` — JSONL (machine consumption) and Chrome
+  trace-event JSON (load in Perfetto / chrome://tracing) sinks.
+
+Everything here is stdlib-only so any module in the package can import it
+without cycles.  See docs/observability.md for the end-to-end workflow.
+"""
+
+from tenzing_tpu.obs.export import (
+    chrome_trace,
+    read_jsonl,
+    to_jsonl,
+    write_chrome_trace,
+    write_jsonl,
+)
+from tenzing_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_metrics,
+    set_metrics,
+)
+from tenzing_tpu.obs.progress import ProgressReporter, get_reporter, set_reporter
+from tenzing_tpu.obs.tracer import Event, Span, Tracer, configure, get_tracer, set_tracer
+
+__all__ = [
+    "Counter",
+    "Event",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "ProgressReporter",
+    "Span",
+    "Tracer",
+    "chrome_trace",
+    "configure",
+    "get_metrics",
+    "get_reporter",
+    "get_tracer",
+    "read_jsonl",
+    "set_metrics",
+    "set_reporter",
+    "set_tracer",
+    "to_jsonl",
+    "write_chrome_trace",
+    "write_jsonl",
+]

@@ -1,0 +1,105 @@
+"""Telemetry sinks: JSONL for machines, Chrome trace-event JSON for Perfetto.
+
+Two serializations of one tracer's records:
+
+* **JSONL** — one JSON object per line (``kind`` = "span" | "event"), in
+  timestamp order: the archival format downstream tooling greps / re-derives
+  statistics from (the "search telemetry is training data" direction of
+  arXiv:2203.02530).  Round-trips through :func:`read_jsonl`.
+* **Chrome trace-event JSON** — the ``{"traceEvents": [...]}`` format
+  Perfetto (https://ui.perfetto.dev) and chrome://tracing load directly:
+  spans as complete events (``ph: "X"`` with ``ts``/``dur``), events as
+  thread-scoped instants (``ph: "i"``), plus ``ph: "M"`` metadata naming
+  each pid "rank N" so merged multi-host bundles read as one process row per
+  rank.  Field semantics: https://docs.google.com/document/d/1CvAClvFfyA5R-
+  PhYUmn5OOQtYMH4h6I0nSsKchNAySU (ts/dur in microseconds).
+
+Multi-host merging: each rank writes its own bundle; concatenating the JSONL
+files (or the ``traceEvents`` lists) merges them — records are pid-tagged
+with the rank, timestamps are unix-anchored.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from tenzing_tpu.obs.tracer import Tracer
+
+
+def _records(tracer: Tracer) -> List[Dict[str, Any]]:
+    recs = [s.to_json() for s in tracer.spans()]
+    recs += [e.to_json() for e in tracer.events()]
+    recs.sort(key=lambda r: r["ts_us"])
+    return recs
+
+
+def to_jsonl(tracer: Tracer) -> str:
+    """All records, one JSON object per line, timestamp-ordered."""
+    return "".join(
+        json.dumps(r, sort_keys=True, default=str) + "\n"
+        for r in _records(tracer)
+    )
+
+
+def write_jsonl(tracer: Tracer, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(to_jsonl(tracer))
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL bundle back to record dicts (the round-trip contract)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def _category(name: str) -> str:
+    """Perfetto category = the subsystem prefix of the record name
+    ("mcts.iter" -> "mcts"); names without a dot categorize as themselves."""
+    return name.split(".", 1)[0]
+
+
+def chrome_trace(tracer: Tracer) -> Dict[str, Any]:
+    """The trace as a Chrome trace-event object (see module docstring)."""
+    trace_events: List[Dict[str, Any]] = []
+    pids = set()
+    for sp in tracer.spans():
+        pids.add(sp.pid)
+        trace_events.append({
+            "name": sp.name,
+            "cat": _category(sp.name),
+            "ph": "X",
+            "ts": sp.ts_us,
+            "dur": sp.dur_us,
+            "pid": sp.pid,
+            "tid": sp.tid,
+            "args": sp.attrs,
+        })
+    for ev in tracer.events():
+        pids.add(ev.pid)
+        trace_events.append({
+            "name": ev.name,
+            "cat": _category(ev.name),
+            "ph": "i",
+            "ts": ev.ts_us,
+            "pid": ev.pid,
+            "tid": ev.tid,
+            "s": "t",  # thread-scoped instant
+            "args": ev.attrs,
+        })
+    trace_events.sort(key=lambda e: e["ts"])
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": f"rank {pid}"}}
+        for pid in sorted(pids)
+    ]
+    return {"traceEvents": meta + trace_events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(tracer: Tracer, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(tracer), f, default=str)

@@ -1,0 +1,206 @@
+"""Metrics registry: counters, gauges, histograms with percentile summaries.
+
+The quantitative half of the telemetry subsystem: where the tracer answers
+"what happened when", the registry answers "how much / how often / how
+slow".  One process-global registry (:func:`get_metrics`) aggregates across
+the whole decision loop — solver phase timings (via the ``utils/counters.py``
+shim), benchmark cache hit rates, measurement counts — and serializes to one
+JSON document (``bench.py --metrics-json``).
+
+Histogram summaries use the same nearest-rank percentile convention as
+``BenchResult`` (utils/numeric.py::percentile — a stdlib-only module, so the
+import stays cycle-free) and retain raw observations up to a cap so archived
+metrics can be re-derived offline without hot loops growing memory unbounded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List
+
+from tenzing_tpu.utils.numeric import percentile
+
+
+class Counter:
+    """Monotonic event count."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Distribution of observations with a percentile summary.
+
+    Aggregates (count/sum/min/max) are exact and O(1) per observation; raw
+    values are retained only up to ``max_raw`` for the percentile summary —
+    a hot loop observing per-node timings (DFS enumeration) cannot grow
+    memory without bound.  A truncated summary carries ``raw_retained`` so
+    downstream tooling knows the percentiles cover a prefix."""
+
+    __slots__ = ("name", "_lock", "_values", "_count", "_sum", "_min",
+                 "_max", "_max_raw")
+
+    def __init__(self, name: str, max_raw: int = 65536):
+        self.name = name
+        self._lock = threading.Lock()
+        self._values: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._max_raw = max(1, max_raw)
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            if value < self._min:
+                self._min = value
+            if value > self._max:
+                self._max = value
+            if len(self._values) < self._max_raw:
+                self._values.append(value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total(self) -> float:
+        return self._sum
+
+    def values(self) -> List[float]:
+        """The retained raw observations (all of them below ``max_raw``)."""
+        with self._lock:
+            return list(self._values)
+
+    def summary(self) -> Dict[str, float]:
+        """count/sum/min/max/mean + nearest-rank p50/p90/p99."""
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "sum": 0.0}
+            xs = sorted(self._values)
+            out = {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "mean": self._sum / self._count,
+                "p50": percentile(xs, 50),
+                "p90": percentile(xs, 90),
+                "p99": percentile(xs, 99),
+            }
+            if len(xs) < self._count:
+                out["raw_retained"] = len(xs)
+            return out
+
+
+class MetricsRegistry:
+    """Get-or-create namespace of instruments; serializes to one JSON doc."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            inst = self._counters.get(name)
+            if inst is None:
+                inst = self._counters[name] = Counter(name)
+            return inst
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            inst = self._gauges.get(name)
+            if inst is None:
+                inst = self._gauges[name] = Gauge(name)
+            return inst
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            inst = self._histograms.get(name)
+            if inst is None:
+                inst = self._histograms[name] = Histogram(name)
+            return inst
+
+    def histograms(self) -> Dict[str, Histogram]:
+        """Snapshot of the registered histograms (name -> instrument)."""
+        with self._lock:
+            return dict(self._histograms)
+
+    @contextmanager
+    def timer(self, name: str):
+        """Time a block into histogram ``name`` (seconds)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.histogram(name).observe(time.perf_counter() - t0)
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in sorted(counters.items())},
+            "gauges": {n: g.value for n, g in sorted(gauges.items())},
+            "histograms": {n: h.summary()
+                           for n, h in sorted(histograms.items())},
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def get_metrics() -> MetricsRegistry:
+    """The process-global registry (always live — recording into it is cheap
+    and reading it is opt-in, so there is no enabled flag to thread around)."""
+    return _GLOBAL
+
+
+def set_metrics(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-global registry (tests); returns the previous one."""
+    global _GLOBAL
+    prev, _GLOBAL = _GLOBAL, registry
+    return prev

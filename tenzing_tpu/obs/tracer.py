@@ -1,0 +1,253 @@
+"""Span/event tracer: the structured replacement for ad-hoc prints and timers.
+
+A :class:`Tracer` records two kinds of things:
+
+* **spans** — named intervals with attributes, nested per thread (a span
+  opened inside another span records it as its parent), opened with the
+  ``with tracer.span("mcts.iter", it=3) as sp:`` context manager; attributes
+  can be added while the span is open (``sp.set("pct50", t)``);
+* **events** — named instants with attributes (``tracer.event("bench.cache",
+  hit=True)``).
+
+Records are tagged with a ``pid`` (the control plane rank — set by
+``parallel/control_plane.py`` so multi-host traces merge into one Perfetto
+timeline, one process row per rank) and a ``tid`` (a dense per-thread index).
+Timestamps are unix-epoch microseconds derived from one ``perf_counter``
+anchor per tracer, so intervals are monotonic within a rank and roughly
+NTP-aligned across ranks.
+
+**Disabled is the default and costs almost nothing**: the module-global
+tracer starts disabled, and a disabled ``span()`` / ``event()`` returns a
+shared no-op immediately — no allocation, no locking, no timestamp (the
+contract tests/test_obs.py::test_disabled_tracer_is_noop relies on).  Enable
+it process-wide with :func:`configure` (what ``bench.py --trace-out`` does).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+def short_digest(payload: str) -> str:
+    """12-hex sha1 of a serialized payload — THE schedule-id convention
+    every telemetry emitter shares (bench.benchmark spans, executor.compile
+    spans, bench.cache events), so trace records for the same schedule
+    correlate byte-for-byte across subsystems and hosts."""
+    return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
+
+class Span:
+    """One finished-or-open interval.  ``ts_us``/``dur_us`` are unix-epoch
+    microseconds; ``attrs`` is a plain JSON-safe dict."""
+
+    __slots__ = ("name", "ts_us", "dur_us", "pid", "tid", "span_id",
+                 "parent_id", "attrs")
+
+    def __init__(self, name: str, ts_us: float, pid: int, tid: int,
+                 span_id: int, parent_id: Optional[int],
+                 attrs: Dict[str, Any]):
+        self.name = name
+        self.ts_us = ts_us
+        self.dur_us = 0.0
+        self.pid = pid
+        self.tid = tid
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+
+    def set(self, key: str, value: Any) -> None:
+        """Attach/overwrite one attribute (usable while the span is open)."""
+        self.attrs[key] = value
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "kind": "span",
+            "name": self.name,
+            "ts_us": self.ts_us,
+            "dur_us": self.dur_us,
+            "pid": self.pid,
+            "tid": self.tid,
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "attrs": self.attrs,
+        }
+
+
+class Event:
+    """One instant with attributes."""
+
+    __slots__ = ("name", "ts_us", "pid", "tid", "attrs")
+
+    def __init__(self, name: str, ts_us: float, pid: int, tid: int,
+                 attrs: Dict[str, Any]):
+        self.name = name
+        self.ts_us = ts_us
+        self.pid = pid
+        self.tid = tid
+        self.attrs = attrs
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "kind": "event",
+            "name": self.name,
+            "ts_us": self.ts_us,
+            "pid": self.pid,
+            "tid": self.tid,
+            "attrs": self.attrs,
+        }
+
+
+class _NullSpan:
+    """The span handed out when tracing is disabled: every method a no-op."""
+
+    __slots__ = ()
+
+    def set(self, key: str, value: Any) -> None:
+        return None
+
+
+class _NullSpanCtx:
+    """Reusable no-op context manager — the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> _NullSpan:
+        return _NULL_SPAN
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+_NULL_CTX = _NullSpanCtx()
+
+
+class Tracer:
+    """Thread-safe span/event recorder (see module docstring)."""
+
+    def __init__(self, enabled: bool = True, rank: int = 0):
+        self.enabled = enabled
+        self.rank = rank
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._events: List[Event] = []
+        self._listeners: List[Callable[[str, Any], None]] = []
+        self._local = threading.local()
+        self._tids: Dict[int, int] = {}
+        self._next_span_id = 0
+        # one perf_counter anchor -> monotonic unix-us timestamps
+        self._t0_unix = time.time()
+        self._t0_perf = time.perf_counter()
+
+    # -- plumbing ----------------------------------------------------------
+    def _now_us(self) -> float:
+        return (self._t0_unix + (time.perf_counter() - self._t0_perf)) * 1e6
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids))
+        return tid
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def set_rank(self, rank: int) -> None:
+        """Tag subsequent records with this control-plane rank (pid)."""
+        self.rank = int(rank)
+
+    def add_listener(self, fn: Callable[[str, Any], None]) -> None:
+        """``fn(kind, record)`` called on every finished span ("span") and
+        emitted event ("event") while the tracer is enabled."""
+        self._listeners.append(fn)
+
+    def _notify(self, kind: str, record: Any) -> None:
+        for fn in self._listeners:
+            try:
+                fn(kind, record)
+            except Exception:
+                pass  # a broken listener must not take down the search
+
+    # -- recording ---------------------------------------------------------
+    def span(self, name: str, **attrs: Any):
+        """Context manager opening a nested span; yields the :class:`Span`."""
+        if not self.enabled:
+            return _NULL_CTX
+        return self._span_ctx(name, attrs)
+
+    @contextmanager
+    def _span_ctx(self, name: str, attrs: Dict[str, Any]) -> Iterator[Span]:
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        with self._lock:
+            span_id = self._next_span_id
+            self._next_span_id += 1
+        sp = Span(name, self._now_us(), self.rank, self._tid(), span_id,
+                  parent, attrs)
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.dur_us = self._now_us() - sp.ts_us
+            stack.pop()
+            with self._lock:
+                self._spans.append(sp)
+            self._notify("span", sp)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Record one instant event."""
+        if not self.enabled:
+            return
+        ev = Event(name, self._now_us(), self.rank, self._tid(), attrs)
+        with self._lock:
+            self._events.append(ev)
+        self._notify("event", ev)
+
+    # -- reading -----------------------------------------------------------
+    def spans(self) -> List[Span]:
+        """Snapshot of finished spans (completion order)."""
+        with self._lock:
+            return list(self._spans)
+
+    def events(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._events.clear()
+
+
+# -- process-global tracer -------------------------------------------------
+
+_GLOBAL = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (disabled until :func:`configure`)."""
+    return _GLOBAL
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-global tracer (tests); returns the previous one."""
+    global _GLOBAL
+    prev, _GLOBAL = _GLOBAL, tracer
+    return prev
+
+
+def configure(enabled: bool = True, rank: Optional[int] = None) -> Tracer:
+    """Enable/disable the global tracer in place (records are kept)."""
+    _GLOBAL.enabled = enabled
+    if rank is not None:
+        _GLOBAL.set_rank(rank)
+    return _GLOBAL

@@ -21,6 +21,8 @@ from typing import Any
 
 import numpy as np
 
+from tenzing_tpu.obs.tracer import get_tracer
+
 
 class ControlPlane:
     """Single-host control plane (world size 1) — the default."""
@@ -52,6 +54,9 @@ class JaxControlPlane(ControlPlane):
         import jax
 
         self._jax = jax
+        # tag all telemetry with this host's rank: multi-host trace bundles
+        # merge into one Perfetto timeline with one process row per rank
+        get_tracer().set_rank(self.rank())
 
     def rank(self) -> int:
         return self._jax.process_index()

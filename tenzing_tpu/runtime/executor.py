@@ -56,6 +56,7 @@ from tenzing_tpu.core.platform import Platform
 from tenzing_tpu.core.resources import Event, Lane
 from tenzing_tpu.core.sequence import Sequence
 from tenzing_tpu.core.serdes import sequence_to_json_str
+from tenzing_tpu.obs.tracer import get_tracer, short_digest
 
 
 def _scalarize(leaf) -> Any:
@@ -377,13 +378,35 @@ class TraceExecutor:
         return self._build(order)
 
     def compile(self, order: Sequence) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
-        """One jitted program per schedule, cached by schedule JSON."""
+        """One jitted program per schedule, cached by schedule JSON.
+
+        With tracing enabled AT BUILD TIME, the FIRST invocation of the
+        returned callable — where jax.jit actually traces and XLA-compiles —
+        is recorded as an ``executor.compile`` span; with tracing disabled
+        the bare jitted callable is cached, zero added overhead (enable
+        tracing before compiling, as ``bench.py --trace-out`` does)."""
         key = sequence_to_json_str(order)
         if key in self._cache:
             return self._cache[key]
-        jitted = jax.jit(self._build(order))
-        self._cache[key] = jitted
-        return jitted
+        tr = get_tracer()
+        with tr.span("executor.build", schedule=short_digest(key),
+                     n_ops=len(order.vector())):
+            jitted = jax.jit(self._build(order))
+        if not tr.enabled:
+            self._cache[key] = jitted
+            return jitted
+        sid = short_digest(key)
+        state = {"cold": True}
+
+        def wrapped(bufs: Dict[str, Any]) -> Dict[str, Any]:
+            if state["cold"]:
+                state["cold"] = False
+                with get_tracer().span("executor.compile", schedule=sid):
+                    return jitted(bufs)
+            return jitted(bufs)
+
+        self._cache[key] = wrapped
+        return wrapped
 
     # -- run ---------------------------------------------------------------
     def run(self, order: Sequence) -> Dict[str, Any]:
@@ -415,8 +438,10 @@ class TraceExecutor:
         narrowing of the final ops) and costs one pass *after* the loop,
         amortized over all n samples."""
         ops = order.vector()
-        key = "n:" + sequence_to_json_str(order)
-        if key in self._cache:
+        sched_json = sequence_to_json_str(order)
+        key = "n:" + sched_json
+        newly_built = key not in self._cache
+        if not newly_built:
             f = self._cache[key]
         else:
             axis_names = self.platform.axis_names
@@ -486,8 +511,27 @@ class TraceExecutor:
             f = jax.jit(stepped)
             self._cache[key] = f
         bufs = self.init_bufs
+        if not (newly_built and get_tracer().enabled):
+            def run_n(n: int) -> None:
+                jax.device_get(f(bufs, jnp.int32(n))[0])
+
+            return run_n
+        # the first invocation of a newly-built program is where jax traces
+        # and XLA compiles (device_get blocks through both) — record it as
+        # an executor.compile span so trace bundles attribute compile wall
+        # separately from steady-state measurement.  The id hashes the
+        # UNPREFIXED schedule JSON so it matches the bench.benchmark span's
+        # schedule_id for the same schedule.
+        sid = short_digest(sched_json)
+        state = {"cold": True}
 
         def run_n(n: int) -> None:
+            if state["cold"]:
+                state["cold"] = False
+                with get_tracer().span("executor.compile", schedule=sid,
+                                       n_samples=n):
+                    jax.device_get(f(bufs, jnp.int32(n))[0])
+                return
             jax.device_get(f(bufs, jnp.int32(n))[0])
 
         return run_n

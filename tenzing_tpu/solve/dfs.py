@@ -13,15 +13,23 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, result_row
+from tenzing_tpu.bench.benchmarker import (
+    BenchOpts,
+    BenchResult,
+    result_row,
+    schedule_id,
+)
 from tenzing_tpu.core import sequence as sequence_mod
 from tenzing_tpu.core.graph import Graph
 from tenzing_tpu.core.operation import ChoiceOp, CompoundOp
 from tenzing_tpu.core.sequence import Sequence
 from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
 from tenzing_tpu.core.state import State
+from tenzing_tpu.obs.progress import get_reporter
+from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
 from tenzing_tpu.utils import trap
+from tenzing_tpu.utils.counters import Counters
 
 
 @dataclass
@@ -62,6 +70,10 @@ class DfsResult:
     """reference dfs::Result (dfs.hpp:74-76, dump_csv dfs.cpp:84-105)."""
 
     sims: List[SimResult] = field(default_factory=list)
+    # phase-timing attribution (SELECT / DEDUP / BENCHMARK / BCAST) — the
+    # MCTS result has carried this since the seed; DFS search time was
+    # unattributable (ISSUE 1 satellite)
+    counters: Optional[Counters] = None
 
     def dump_csv(self, path: Optional[str] = None) -> str:
         rows = [result_row(i, s.result, s.order) for i, s in enumerate(self.sims)]
@@ -78,14 +90,20 @@ class DfsResult:
 
 
 def _dfs_terminals(
-    graph: Graph, platform, max_seqs: int, dedup_terminals: bool
+    graph: Graph, platform, max_seqs: int, dedup_terminals: bool,
+    counters: Optional[Counters] = None,
 ) -> List[State]:
     """Worklist DFS over ``State.frontier`` (reference get_all_sequences,
     dfs.cpp:16-82; the per-expansion dedup is dfs.cpp:46-58).  With
     ``dedup_terminals`` the cap counts bijection-unique terminals, recognized
     by O(1) ``canonical_key`` lookups (equivalent to the reference's pairwise
     bijection scan — canonical keys are equal iff a lane/event bijection
-    exists; agreement is property-tested in tests/test_dedup_canonical.py)."""
+    exists; agreement is property-tested in tests/test_dedup_canonical.py).
+
+    ``counters`` attributes the walk per node: frontier expansion under
+    SELECT, canonical-key dedup under DEDUP (spanless — a tracer span per
+    node would flood the trace; the aggregate lands in the metrics)."""
+    c = counters if counters is not None else Counters(mirror_global=False)
     terminals: List[State] = []
     seen_keys: set = set()
     stack: List[State] = [State(graph)]
@@ -93,34 +111,41 @@ def _dfs_terminals(
         st = stack.pop()
         if st.is_terminal():
             if dedup_terminals:
-                key = sequence_mod.canonical_key(st.sequence)
-                if key in seen_keys:
+                with c.phase("DEDUP", span=False):
+                    key = sequence_mod.canonical_key(st.sequence)
+                    dup = key in seen_keys
+                    seen_keys.add(key)
+                if dup:
                     continue
-                seen_keys.add(key)
             terminals.append(st)
             continue
-        stack.extend(st.frontier(platform))
+        with c.phase("SELECT", span=False):
+            stack.extend(st.frontier(platform))
     return terminals
 
 
 def get_all_sequences(
-    graph: Graph, platform, max_seqs: int = 15000
+    graph: Graph, platform, max_seqs: int = 15000,
+    counters: Optional[Counters] = None,
 ) -> List[State]:
     """All complete schedules reachable from the initial state (terminal
     duplicates across converging DFS paths included; ``max_seqs`` caps raw
     terminals)."""
-    return _dfs_terminals(graph, platform, max_seqs, dedup_terminals=False)
+    return _dfs_terminals(graph, platform, max_seqs, dedup_terminals=False,
+                          counters=counters)
 
 
 def get_unique_sequences(
-    graph: Graph, platform, max_seqs: int = 15000
+    graph: Graph, platform, max_seqs: int = 15000,
+    counters: Optional[Counters] = None,
 ) -> List[State]:
     """Like :func:`get_all_sequences`, but terminals are deduplicated under
     resource bijection *as they are found* and ``max_seqs`` counts unique
     terminals — the same cap semantics as the native core
     (native/src/core.cpp enumerate_sequences), so ``TENZING_TPU_NATIVE=0``
     and ``=1`` see the same capped terminal set for the same budget."""
-    return _dfs_terminals(graph, platform, max_seqs, dedup_terminals=True)
+    return _dfs_terminals(graph, platform, max_seqs, dedup_terminals=True,
+                          counters=counters)
 
 
 def expand_all(graph: Graph) -> Graph:
@@ -148,7 +173,8 @@ def structural_variants(graph: Graph) -> List[Graph]:
     return out
 
 
-def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000) -> List[State]:
+def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000,
+                        counters: Optional[Counters] = None) -> List[State]:
     """Terminal states with both per-expansion and terminal dedup applied.
 
     Structural decisions (compound expansion, implementation choices) are
@@ -159,31 +185,40 @@ def enumerate_schedules(graph: Graph, platform, max_seqs: int = 15000) -> List[S
     share flows to later variants.  Both paths count *deduplicated* terminals
     against the cap (same semantics either way; cross-checked in
     tests/test_native.py)."""
-    import sys
-
     from tenzing_tpu.native import bridge
 
+    reporter = get_reporter()
+    tr = get_tracer()
     variants = structural_variants(graph)
     out: List[State] = []
     for k, g in enumerate(variants):
         remaining = max_seqs - len(out)
         if remaining <= 0:
-            print(
+            reporter.warn(
                 f"tenzing-tpu: dfs budget exhausted; {len(variants) - k} structural "
                 "variant(s) not enumerated (raise max_seqs)",
-                file=sys.stderr,
+                variants_left=len(variants) - k, max_seqs=max_seqs,
             )
             break
         share = -(-remaining // (len(variants) - k))  # ceil fair share
-        nat = bridge.try_enumerate(g, platform, share, dedup_terminals=True)
-        if nat is None:
-            nat = get_unique_sequences(g, platform, share)
+        with tr.span("dfs.enumerate_variant", variant=k, share=share) as sp:
+            # the native core enumerates (and dedups) opaquely — its whole
+            # wall is SELECT; the Python fallback self-attributes per node
+            c = counters if counters is not None else Counters(
+                mirror_global=False)
+            with c.phase("SELECT", span=False):
+                nat = bridge.try_enumerate(g, platform, share,
+                                           dedup_terminals=True)
+            if nat is None:
+                nat = get_unique_sequences(g, platform, share,
+                                           counters=counters)
+            sp.set("n_terminals", len(nat))
         truncated = len(nat) >= share
         if truncated and k + 1 < len(variants):
-            print(
+            reporter.warn(
                 f"tenzing-tpu: dfs variant {k} truncated at its fair share "
                 f"({share} schedules)",
-                file=sys.stderr,
+                variant=k, share=share,
             )
         out.extend(nat)
     return out
@@ -214,9 +249,15 @@ def explore(
 ) -> DfsResult:
     """Enumerate, dedup, benchmark every schedule (reference dfs::explore,
     dfs.hpp:78-178)."""
+    import sys
+
     opts = opts if opts is not None else DfsOpts()
     cp = control_plane if control_plane is not None else default_control_plane()
-    result = DfsResult()
+    tr = get_tracer()
+    tr.set_rank(cp.rank())
+    reporter = get_reporter()
+    counters = Counters(prefix="dfs.phase")
+    result = DfsResult(counters=counters)
     batch_partial: dict = {}  # orders + in-flight times for mid-batch dumps
 
     def dump_partial():  # reference dfs.hpp:118-122
@@ -232,62 +273,75 @@ def explore(
         if opts.dump_csv_path:
             result.dump_csv(opts.dump_csv_path)
         else:
-            print(result.dump_csv(), end="")
+            sys.stdout.write(result.dump_csv())
 
     trap.register_handler(dump_partial)
     try:
-        if cp.rank() == 0:
-            states = enumerate_schedules(graph, platform, opts.max_seqs)
-            n = len(states)
-        else:
-            states, n = [], 0
-        n = cp.bcast_json(n)  # stop-flag protocol (dfs.hpp:50-70)
-        batch_times_fn = getattr(benchmarker, "benchmark_batch_times", None)
-        if opts.batch and (batch_times_fn is None or cp.size() != 1):
+        with tr.span("dfs.explore", max_seqs=opts.max_seqs,
+                     batch=opts.batch) as root_sp:
             if cp.rank() == 0:
-                import sys
-
-                why = (
-                    "multi-host control plane"
-                    if cp.size() != 1
-                    else f"{type(benchmarker).__name__} has no benchmark_batch_times"
-                )
-                print(
-                    f"tenzing-tpu: dfs batch=True ignored ({why}); falling back "
-                    "to one-at-a-time (correlated) benchmarking",
-                    file=sys.stderr,
-                )
-        if opts.batch and batch_times_fn is not None and cp.size() == 1:
-            orders = [st.sequence for st in states]
-            times: List[List[float]] = [[] for _ in orders]
-            batch_partial.update(orders=orders, times=times)
-            batch_times_fn(
-                orders, opts.bench_opts, seed=opts.batch_seed, times_out=times
-            )
-            for order, ts in zip(orders, times):
-                result.sims.append(
-                    SimResult(order=order, result=BenchResult.from_times(ts))
-                )
-            # only after the results are in result.sims: a signal landing
-            # between clear() and the copy would otherwise dump an empty CSV
-            # despite every measurement having completed (trap.py contract)
-            batch_partial.clear()
-        else:
-            for i in range(n):
+                with tr.span("dfs.enumerate"):
+                    states = enumerate_schedules(graph, platform,
+                                                 opts.max_seqs,
+                                                 counters=counters)
+                n = len(states)
+            else:
+                states, n = [], 0
+            with counters.phase("BCAST"):
+                n = cp.bcast_json(n)  # stop-flag protocol (dfs.hpp:50-70)
+            root_sp.set("n_schedules", n)
+            batch_times_fn = getattr(benchmarker, "benchmark_batch_times", None)
+            if opts.batch and (batch_times_fn is None or cp.size() != 1):
                 if cp.rank() == 0:
-                    st = states[i]
-                    payload = sequence_to_json(st.sequence)
-                else:
-                    st, payload = None, None
-                payload = cp.bcast_json(payload)
-                if cp.rank() == 0:
-                    order = st.sequence
-                else:
-                    order = sequence_from_json(payload, graph)
-                res = benchmarker.benchmark(order, opts.bench_opts)
-                result.sims.append(SimResult(order=order, result=res))
-        if opts.dump_csv_path and cp.rank() == 0:
-            result.dump_csv(opts.dump_csv_path)
-        return result
+                    why = (
+                        "multi-host control plane"
+                        if cp.size() != 1
+                        else f"{type(benchmarker).__name__} has no benchmark_batch_times"
+                    )
+                    reporter.warn(
+                        f"tenzing-tpu: dfs batch=True ignored ({why}); falling back "
+                        "to one-at-a-time (correlated) benchmarking",
+                        why=why,
+                    )
+            if opts.batch and batch_times_fn is not None and cp.size() == 1:
+                orders = [st.sequence for st in states]
+                times: List[List[float]] = [[] for _ in orders]
+                batch_partial.update(orders=orders, times=times)
+                with counters.phase("BENCHMARK"):
+                    batch_times_fn(
+                        orders, opts.bench_opts, seed=opts.batch_seed,
+                        times_out=times
+                    )
+                for order, ts in zip(orders, times):
+                    result.sims.append(
+                        SimResult(order=order, result=BenchResult.from_times(ts))
+                    )
+                # only after the results are in result.sims: a signal landing
+                # between clear() and the copy would otherwise dump an empty CSV
+                # despite every measurement having completed (trap.py contract)
+                batch_partial.clear()
+            else:
+                for i in range(n):
+                    with tr.span("dfs.iter", i=i) as sp:
+                        if cp.rank() == 0:
+                            st = states[i]
+                            payload = sequence_to_json(st.sequence)
+                        else:
+                            st, payload = None, None
+                        with counters.phase("BCAST"):
+                            payload = cp.bcast_json(payload)
+                        if cp.rank() == 0:
+                            order = st.sequence
+                        else:
+                            order = sequence_from_json(payload, graph)
+                        with counters.phase("BENCHMARK"):
+                            res = benchmarker.benchmark(order, opts.bench_opts)
+                        if tr.enabled:
+                            sp.set("schedule", schedule_id(order))
+                            sp.set("pct50", res.pct50)
+                        result.sims.append(SimResult(order=order, result=res))
+            if opts.dump_csv_path and cp.rank() == 0:
+                result.dump_csv(opts.dump_csv_path)
+            return result
     finally:
         trap.unregister_handler(dump_partial)

@@ -21,12 +21,15 @@ from tenzing_tpu.bench.benchmarker import (
     BenchResult,
     CachingBenchmarker,
     result_row,
+    schedule_id,
 )
 from tenzing_tpu.core.graph import Graph
 from tenzing_tpu.core.schedule import remove_redundant_syncs
 from tenzing_tpu.core.sequence import Sequence, canonical_key
 from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
 from tenzing_tpu.core.state import State
+from tenzing_tpu.obs.progress import get_reporter
+from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.parallel.control_plane import ControlPlane, default_control_plane
 from tenzing_tpu.solve.mcts.node import Node
 from tenzing_tpu.solve.mcts.strategies import FastMin
@@ -175,8 +178,11 @@ def explore(
     opts = opts if opts is not None else MctsOpts()
     strategy = strategy if strategy is not None else FastMin
     cp = control_plane if control_plane is not None else default_control_plane()
+    tr = get_tracer()
+    tr.set_rank(cp.rank())
+    reporter = get_reporter()
     rng = _random.Random(opts.seed)
-    counters = Counters()
+    counters = Counters(prefix="mcts.phase")
     result = MctsResult(counters=counters)
     if opts.cache_benchmarks and not isinstance(benchmarker, CachingBenchmarker):
         # cache locally on every host: the broadcast order is identical on all
@@ -187,9 +193,14 @@ def explore(
         if opts.dump_csv_path:
             result.dump_csv(opts.dump_csv_path)
         else:
-            print(result.dump_csv(), end="")
+            sys.stdout.write(result.dump_csv())
 
     trap.register_handler(dump_partial)
+    # manual enter/exit (not `with`): the finally below must set the
+    # run-total attrs on every exit path, including the mid-block return
+    explore_ctx = tr.span("mcts.explore", n_iters=opts.n_iters,
+                          seed=opts.seed)
+    explore_sp = explore_ctx.__enter__()
     try:
         ctx = strategy.Context(seed=opts.seed)
         root = Node(State(graph), strategy) if cp.rank() == 0 else None
@@ -198,106 +209,125 @@ def explore(
         seed_iter = iter(seeds if seeds is not None else ())
         failed_keys: set = set()  # negative cache for uncompilable schedules
         for it in range(opts.n_iters):
-            stop = False
-            order: Optional[Sequence] = None
-            endpoint: Optional[Node] = None
-            if cp.rank() == 0:
-                assert root is not None
-                path = next(seed_iter, None)
-                if path is not None:
-                    with counters.phase("SEED"):
-                        endpoint, st = _materialize_seed(root, path)
-                        if not st.is_terminal():  # defensive: complete
-                            _, order = endpoint.get_rollout(
-                                platform, rng,
+            # per-iteration span (ISSUE 1): which node/path was selected,
+            # the rolled-out schedule's hash, the measured time and the tree
+            # size — the phase spans (mcts.phase.*) nest inside it
+            with tr.span("mcts.iter", it=it) as it_sp:
+                stop = False
+                order: Optional[Sequence] = None
+                endpoint: Optional[Node] = None
+                if cp.rank() == 0:
+                    assert root is not None
+                    path = next(seed_iter, None)
+                    if path is not None:
+                        it_sp.set("seeded", True)
+                        with counters.phase("SEED"):
+                            endpoint, st = _materialize_seed(root, path)
+                            if not st.is_terminal():  # defensive: complete
+                                _, order = endpoint.get_rollout(
+                                    platform, rng,
+                                    policy=opts.rollout_policy,
+                                    policy_eps=opts.rollout_eps,
+                                )
+                            else:
+                                # benchmarked AS RECORDED (no redundant-sync
+                                # cleanup): the cache key matches the incumbent's
+                                # measurement exactly when the rollout opts do
+                                # (with a multi-fidelity screen floor the seed is
+                                # instead re-measured cheaply at that floor)
+                                order = st.sequence
+                    elif root.fully_visited_:
+                        stop = True
+                    else:
+                        with counters.phase("SELECT"):
+                            leaf = root.select(ctx, platform, rng)
+                        with counters.phase("EXPAND"):
+                            child = leaf.expand(platform, rng)
+                        with counters.phase("ROLLOUT"):
+                            endpoint, order = child.get_rollout(
+                                platform, rng, opts.expand_rollout,
                                 policy=opts.rollout_policy,
                                 policy_eps=opts.rollout_eps,
                             )
-                        else:
-                            # benchmarked AS RECORDED (no redundant-sync
-                            # cleanup): the cache key matches the incumbent's
-                            # measurement exactly when the rollout opts do
-                            # (with a multi-fidelity screen floor the seed is
-                            # instead re-measured cheaply at that floor)
-                            order = st.sequence
-                elif root.fully_visited_:
-                    stop = True
-                else:
-                    with counters.phase("SELECT"):
-                        leaf = root.select(ctx, platform, rng)
-                    with counters.phase("EXPAND"):
-                        child = leaf.expand(platform, rng)
-                    with counters.phase("ROLLOUT"):
-                        endpoint, order = child.get_rollout(
-                            platform, rng, opts.expand_rollout,
-                            policy=opts.rollout_policy,
-                            policy_eps=opts.rollout_eps,
-                        )
-                    with counters.phase("REDUNDANT_SYNC"):
-                        order = remove_redundant_syncs(order)
-            # stop-flag + schedule broadcast (reference mcts.hpp:129-152,244)
-            with counters.phase("BCAST"):
-                stop = cp.bcast_json(stop)
-                if stop:
-                    break
-                payload = cp.bcast_json(
-                    sequence_to_json(order) if cp.rank() == 0 else None
-                )
-                if cp.rank() != 0:
-                    order = sequence_from_json(payload, graph)
-            # event provisioning (reference mcts.hpp:247-270)
-            events = []
-            for op in order:
-                if hasattr(op, "events"):
-                    events.extend(op.events())
-            platform.provision_events(events)
-            key = canonical_key(order)
-            ropts = opts.screen_opts if opts.screen_opts is not None else (
-                opts.bench_opts)
-            res: Optional[BenchResult] = None
-            if key not in failed_keys:
-                with counters.phase("BENCHMARK"):
-                    try:
-                        res = benchmarker.benchmark(order, ropts)
-                    except Exception as e:
-                        # a rollout whose schedule cannot compile/run on the
-                        # hardware (e.g. liveness exceeding device memory) is
-                        # a legitimate dead end, not a search crash.  Only
-                        # safe single-host: under a multi-host control plane a
-                        # rank-local failure would desync the per-measurement
-                        # barrier/allreduce protocol, so there the error must
-                        # propagate (a crash beats a collective deadlock).
-                        if cp.size() > 1:
-                            raise
-                        sys.stderr.write(
-                            "mcts: rollout rejected (failed to compile/run: "
-                            f"{type(e).__name__}: {str(e)[:200]})\n"
-                        )
-                        failed_keys.add(key)
-            if res is None:
-                # negative-cached or fresh failure: backprop a penalty (2x
-                # the worst time seen) so the tree learns to avoid the
-                # region without re-paying the failing compile; no sim is
-                # recorded (no fake measurements in the result set)
-                worst = max(
-                    (s.result.pct50 for s in result.sims), default=1.0
-                )
-                pen = BenchResult.from_times([2.0 * worst])
+                        with counters.phase("REDUNDANT_SYNC"):
+                            order = remove_redundant_syncs(order)
+                        if tr.enabled and child.decision is not None:
+                            it_sp.set("selected", child.decision.desc())
+                # stop-flag + schedule broadcast (mcts.hpp:129-152,244)
+                with counters.phase("BCAST"):
+                    stop = cp.bcast_json(stop)
+                    if stop:
+                        break
+                    payload = cp.bcast_json(
+                        sequence_to_json(order) if cp.rank() == 0 else None
+                    )
+                    if cp.rank() != 0:
+                        order = sequence_from_json(payload, graph)
+                # event provisioning (reference mcts.hpp:247-270)
+                events = []
+                for op in order:
+                    if hasattr(op, "events"):
+                        events.extend(op.events())
+                platform.provision_events(events)
+                key = canonical_key(order)
+                if tr.enabled:
+                    it_sp.set("schedule", schedule_id(order))
+                ropts = opts.screen_opts if opts.screen_opts is not None else (
+                    opts.bench_opts)
+                res: Optional[BenchResult] = None
+                if key not in failed_keys:
+                    with counters.phase("BENCHMARK"):
+                        try:
+                            res = benchmarker.benchmark(order, ropts)
+                        except Exception as e:
+                            # a rollout whose schedule cannot compile/run on
+                            # the hardware (e.g. liveness exceeding device
+                            # memory) is a legitimate dead end, not a search
+                            # crash.  Only safe single-host: under a
+                            # multi-host control plane a rank-local failure
+                            # would desync the per-measurement barrier/
+                            # allreduce protocol, so there the error must
+                            # propagate (a crash beats a collective deadlock).
+                            if cp.size() > 1:
+                                raise
+                            reporter.warn(
+                                "mcts: rollout rejected (failed to compile/"
+                                f"run: {type(e).__name__}: {str(e)[:200]})",
+                                it=it,
+                            )
+                            failed_keys.add(key)
+                if res is None:
+                    # negative-cached or fresh failure: backprop a penalty
+                    # (2x the worst time seen) so the tree learns to avoid
+                    # the region without re-paying the failing compile; no
+                    # sim is recorded (no fake measurements in the result
+                    # set)
+                    it_sp.set("rejected", True)
+                    worst = max(
+                        (s.result.pct50 for s in result.sims), default=1.0
+                    )
+                    pen = BenchResult.from_times([2.0 * worst])
+                    if cp.rank() == 0:
+                        with counters.phase("BACKPROP"):
+                            endpoint.backprop(ctx, pen)
+                    continue
+                fidelity = ("screen" if opts.screen_opts is not None
+                            else "full")
+                if tr.enabled:
+                    it_sp.set("pct50", res.pct50)
+                    it_sp.set("fidelity", fidelity)
+                result.sims.append(SimResult(
+                    order=order, result=res, fidelity=fidelity,
+                ))
                 if cp.rank() == 0:
                     with counters.phase("BACKPROP"):
-                        endpoint.backprop(ctx, pen)
-                continue
-            result.sims.append(SimResult(
-                order=order, result=res,
-                fidelity="screen" if opts.screen_opts is not None else "full",
-            ))
-            if cp.rank() == 0:
-                with counters.phase("BACKPROP"):
-                    endpoint.backprop(ctx, res)
-                if opts.dump_tree and _dump_cadence(it):
-                    path = f"{opts.dump_tree_prefix}_{it:06d}.dot"
-                    with open(path, "w") as f:
-                        f.write(root.dump_graphviz())
+                        endpoint.backprop(ctx, res)
+                    if tr.enabled:
+                        it_sp.set("tree_size", root.size())
+                    if opts.dump_tree and _dump_cadence(it):
+                        path = f"{opts.dump_tree_prefix}_{it:06d}.dot"
+                        with open(path, "w") as f:
+                            f.write(root.dump_graphviz())
         # multi-fidelity confirm: the top-k distinct screened schedules are
         # re-measured at the full bench_opts floor so the solver's official
         # output carries final-fidelity numbers (the CachingBenchmarker key
@@ -337,9 +367,10 @@ def explore(
                     except Exception as e:
                         if cp.size() > 1:
                             raise
-                        sys.stderr.write(
+                        reporter.warn(
                             "mcts: confirm rejected (failed to compile/run: "
-                            f"{type(e).__name__}: {str(e)[:200]})\n"
+                            f"{type(e).__name__}: {str(e)[:200]})",
+                            finalist=fi,
                         )
                         continue
                 result.sims.append(
@@ -350,4 +381,7 @@ def explore(
             result.dump_csv(opts.dump_csv_path)
         return result
     finally:
+        explore_sp.set("n_sims", len(result.sims))
+        explore_sp.set("tree_size", result.tree_size)
+        explore_ctx.__exit__(None, None, None)
         trap.unregister_handler(dump_partial)

@@ -1,7 +1,20 @@
 """Phase-timing counters (reference counters.hpp:26-34 and the MCTS counters,
 tenzing-mcts/include/tenzing/mcts/counters.hpp:16-27): accumulate wall time per
 solver phase — SELECT / EXPAND / ROLLOUT / REDUNDANT_SYNC / BCAST / BENCHMARK /
-BACKPROP — and report at the end of a search (mcts.hpp:311-320)."""
+BACKPROP — and report at the end of a search (mcts.hpp:311-320).
+
+Compatibility shim over :mod:`tenzing_tpu.obs.metrics` (ISSUE 1): each
+``Counters`` owns a private histogram per phase, and every ``phase()`` block
+
+* observes its duration into that histogram (``seconds``/``counts``/
+  ``report()`` keep the exact legacy API and format),
+* mirrors it into the process-global registry as
+  ``<prefix>.<NAME>.seconds`` — so ``bench.py --metrics-json`` archives the
+  solver phase timings without the solvers threading a registry around, and
+* opens a ``<prefix>.<NAME>`` span on the global tracer — so enabling
+  tracing shows every solver phase nested inside its iteration span in
+  Perfetto, at no cost when tracing is disabled.
+"""
 
 from __future__ import annotations
 
@@ -9,26 +22,54 @@ import time
 from contextlib import contextmanager
 from typing import Dict
 
+from tenzing_tpu.obs.metrics import MetricsRegistry, get_metrics
+from tenzing_tpu.obs.tracer import get_tracer
+
 
 class Counters:
-    def __init__(self) -> None:
-        self.seconds: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
+    def __init__(self, prefix: str = "solver.phase",
+                 mirror_global: bool = True) -> None:
+        self._registry = MetricsRegistry()
+        self._prefix = prefix
+        self._mirror_global = mirror_global
 
     @contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, span: bool = True):
+        """Time a block under phase ``name``.  ``span=False`` skips the
+        tracer span (counters/metrics only) — for per-node inner loops (DFS
+        enumeration) where a span per entry would flood the trace."""
+        ctx = get_tracer().span(f"{self._prefix}.{name}") if span else None
+        if ctx is not None:
+            ctx.__enter__()
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.seconds[name] = self.seconds.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+            self._registry.histogram(name).observe(dt)
+            if self._mirror_global:
+                get_metrics().histogram(
+                    f"{self._prefix}.{name}.seconds").observe(dt)
+
+    @property
+    def seconds(self) -> Dict[str, float]:
+        """Accumulated wall seconds per phase (legacy dict API)."""
+        return {name: h.total
+                for name, h in self._registry.histograms().items()}
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        """Times each phase was entered (legacy dict API)."""
+        return {name: h.count
+                for name, h in self._registry.histograms().items()}
 
     def report(self) -> str:
         lines = ["phase counters:"]
-        for name in sorted(self.seconds, key=lambda n: -self.seconds[n]):
+        seconds, counts = self.seconds, self.counts
+        for name in sorted(seconds, key=lambda n: -seconds[n]):
             lines.append(
-                f"  {name:>16}: {self.seconds[name]:9.3f}s  x{self.counts[name]}"
+                f"  {name:>16}: {seconds[name]:9.3f}s  x{counts[name]}"
             )
         return "\n".join(lines)

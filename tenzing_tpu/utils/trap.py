@@ -19,7 +19,9 @@ def _handler(signum, frame):  # pragma: no cover - signal path
         try:
             cb()
         except Exception as e:
-            print(f"trap: dump callback failed: {e}", file=sys.stderr)
+            # bare write, not the ProgressReporter: a signal handler must
+            # not touch shared telemetry state mid-crash
+            sys.stderr.write(f"trap: dump callback failed: {e}\n")
     signal.signal(signum, signal.SIG_DFL)
     signal.raise_signal(signum)
 

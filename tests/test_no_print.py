@@ -1,0 +1,74 @@
+"""Lint (ISSUE 1 satellite): no bare ``print(`` in tenzing_tpu/ library code.
+
+All human-facing output must flow through ``obs.progress.ProgressReporter``
+(progress/diagnostics) or an explicit stream write (``sys.stdout.write`` for
+machine-readable dumps like the CSV partial-dump paths) — a bare ``print``
+bypasses both the telemetry event stream and stream discipline, and one
+stray line on stdout corrupts the drivers' one-JSON-line protocol.
+
+Tokenize-based (not regex): ``print`` inside strings, comments, and
+docstrings does not trip it.  The allowlist exists for CLI dump paths not
+yet migrated to the reporter — currently empty; add ``"subdir/file.py"``
+(path relative to tenzing_tpu/) entries only with a migration plan.
+"""
+
+import io
+import tokenize
+from pathlib import Path
+
+LIBRARY_ROOT = Path(__file__).resolve().parent.parent / "tenzing_tpu"
+
+# relative-to-tenzing_tpu paths allowed to keep bare print() until migrated
+ALLOWLIST: set = set()
+
+
+def _print_calls(source: str):
+    """(line, col) of every ``print(`` call in ``source``."""
+    toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    hits = []
+    for i, tok in enumerate(toks):
+        if tok.type == tokenize.NAME and tok.string == "print":
+            # attribute access (x.print) is not the builtin
+            prev = next((t for t in reversed(toks[:i])
+                         if t.type not in (tokenize.NL, tokenize.NEWLINE,
+                                           tokenize.INDENT, tokenize.DEDENT,
+                                           tokenize.COMMENT)), None)
+            if prev is not None and prev.type == tokenize.OP and prev.string == ".":
+                continue
+            nxt = next((t for t in toks[i + 1:]
+                        if t.type not in (tokenize.NL, tokenize.NEWLINE,
+                                          tokenize.COMMENT)), None)
+            if nxt is not None and nxt.type == tokenize.OP and nxt.string == "(":
+                hits.append((tok.start[0], tok.start[1]))
+    return hits
+
+
+def test_no_bare_print_in_library_code():
+    offenders = []
+    for path in sorted(LIBRARY_ROOT.rglob("*.py")):
+        rel = path.relative_to(LIBRARY_ROOT).as_posix()
+        if rel in ALLOWLIST:
+            continue
+        for line, col in _print_calls(path.read_text()):
+            offenders.append(f"tenzing_tpu/{rel}:{line}:{col}")
+    assert not offenders, (
+        "bare print() in library code (route through "
+        "obs.progress.get_reporter() or an explicit stream write):\n  "
+        + "\n  ".join(offenders)
+    )
+
+
+def test_allowlist_entries_still_exist():
+    """A stale allowlist entry hides nothing — prune it."""
+    for rel in ALLOWLIST:
+        assert (LIBRARY_ROOT / rel).is_file(), f"stale allowlist entry: {rel}"
+
+
+def test_print_detector_self_check():
+    src = (
+        "x = 'print(not me)'\n"
+        "# print(also not me)\n"
+        "obj.print('method, not builtin')\n"
+        "print('caught')\n"
+    )
+    assert _print_calls(src) == [(4, 0)]
