@@ -246,6 +246,26 @@ def main() -> int:
                          "'' disables)")
     ap.add_argument("--seed-topk", type=int, default=3,
                     help="recorded schedules to carry as candidates")
+    ap.add_argument("--learn-train", nargs="+", default=None,
+                    metavar="CORPUS",
+                    help="train the schedule-cost surrogate on these "
+                         "recorded-search CSV globs (labels: in-file ratio "
+                         "vs each file's naive anchor), save it to "
+                         "--learn-model, print a summary JSON line and exit "
+                         "(docs/learn.md)")
+    ap.add_argument("--learn-trace", nargs="*", default=None,
+                    metavar="TRACE",
+                    help="telemetry-bundle JSONL globs joined onto the "
+                         "training corpus by schedule digest (provenance "
+                         "counts; used with --learn-train)")
+    ap.add_argument("--learn-model", default=None,
+                    help="surrogate model JSON: written by --learn-train, "
+                         "read by --learn-screen")
+    ap.add_argument("--learn-screen", action="store_true",
+                    help="prescreen MCTS rollouts with the --learn-model "
+                         "surrogate, escalating only plausible-top-k / "
+                         "uncertain candidates to the device; also prunes "
+                         "hill-climb neighbors the model can rule out")
     args = ap.parse_args()
 
     if args.smoke:
@@ -338,6 +358,66 @@ def main() -> int:
              "moe": build_moe}[args.workload]
     built = build(args)
     g, bufs, metric = built[0], built[1], built[2]
+    # buffer byte sizes feed the surrogate's comm-bytes + analytic-makespan
+    # features (learn/features.py) — the same map for train and screen, so
+    # the feature contract holds across the two phases
+    learn_nbytes = {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
+
+    if args.learn_train:
+        # corpus -> features -> ridge ensemble -> model JSON, then exit:
+        # training is offline (no device measurement), it only needs the
+        # workload graph to deserialize the recorded schedules against
+        import glob as _glob
+
+        from tenzing_tpu import obs as _obs
+        from tenzing_tpu.learn import Corpus, RidgeEnsemble, FEATURE_NAMES, spearman
+
+        log = lambda m: sys.stderr.write(m + "\n")
+        paths = sorted(p for pat in args.learn_train
+                       for p in _glob.glob(pat))
+        with _obs.get_tracer().span("learn.train", n_files=len(paths)):
+            corpus = Corpus.from_files(paths, g, log=log)
+            if args.learn_trace:
+                tpaths = sorted(p for pat in args.learn_trace
+                                for p in _glob.glob(pat))
+                corpus.attach_traces(tpaths, log=log)
+            out = {"metric": f"learn_train_{args.workload}",
+                   "files": len(paths), "rows": len(corpus.rows)}
+            if len(corpus.rows) < 4:
+                out["error"] = "corpus too small to train (< 4 rows)"
+            else:
+                X, y = corpus.matrices(nbytes=learn_nbytes)
+                model = RidgeEnsemble(feature_names=list(FEATURE_NAMES))
+                model.fit(X, y)
+                pred, _ = model.predict(X)
+                out["train_spearman"] = round(spearman(pred, y), 4)
+                if args.learn_model:
+                    model.save(args.learn_model)
+                    out["model"] = args.learn_model
+                    log(f"learn model: {args.learn_model} "
+                        f"({len(corpus.rows)} rows, train spearman "
+                        f"{out['train_spearman']})")
+        write_telemetry()
+        print(json.dumps(out))
+        return 0
+
+    surrogate = None
+    if args.learn_screen and args.learn_model:
+        from tenzing_tpu.learn import (
+            FEATURE_NAMES,
+            RidgeEnsemble,
+            SurrogateBenchmarker,
+        )
+
+        model = RidgeEnsemble.load(args.learn_model,
+                                   expect_features=list(FEATURE_NAMES))
+        surrogate = SurrogateBenchmarker(model, nbytes=learn_nbytes)
+        sys.stderr.write(
+            f"learn screen: {args.learn_model} "
+            f"({model.n_train} training rows)\n")
+    elif args.learn_screen:
+        sys.stderr.write("learn screen: no --learn-model given — "
+                         "screening disabled\n")
     # 8 lanes for halo: the probed greedy lane-count curve peaks at 6-8 lanes
     # (paired 1.38-1.42 vs 1.18-1.23 at 2) and the repeat driver winner is the
     # mixed-engine 8-lane incumbent — searching on 8 lanes puts the hill-climb
@@ -661,16 +741,32 @@ def main() -> int:
         n_iters=max(5, args.iters), max_retries=2,
         target_secs=search_opts.target_secs * 10,
     )
+    search_bench = bench
+    if surrogate is not None:
+        # the learned screen slots into the existing screen/confirm split:
+        # rollout queries (mcts_screen opts) may be answered by the model,
+        # while the confirm pass and everything at any other fidelity
+        # always reaches the device (screen_only_opts)
+        from tenzing_tpu.learn import ScreeningBenchmarker
+
+        search_bench = ScreeningBenchmarker(
+            surrogate, bench, escalate_topk=max(4, args.seed_topk + 1),
+            screen_only_opts=mcts_screen,
+        )
     res = explore(
         g,
         plat,
-        bench,
+        search_bench,
         MctsOpts(n_iters=args.mcts_iters, bench_opts=mcts_confirm,
                  screen_opts=mcts_screen, confirm_topk=4, seed=0,
                  rollout_policy=mcts_rollout_policy),
         strategy=FastMin,
         seeds=seed_paths,
     )
+    if surrogate is not None:
+        sys.stderr.write(
+            f"learn screen: {search_bench.hits} surrogate answers / "
+            f"{search_bench.escalations} escalations\n")
     confirmed = [s for s in res.sims if s.fidelity == "full"]
     best_seen = min(
         (s.result.pct50 for s in (confirmed or res.sims)),
@@ -805,7 +901,8 @@ def main() -> int:
             lres = hill_climb(
                 g, cplat, bench, cphases, prefer=cprefer, priority=cpriority,
                 opts=LocalOpts(budget=cbudget, bench_opts=climb_opts,
-                               seed=2 + ci, paired=True),
+                               seed=2 + ci, paired=True,
+                               prescreen=surrogate),
             )
             lbest = lres.best()
             sys.stderr.write(
@@ -1023,12 +1120,31 @@ def main() -> int:
             for s in top:
                 idx = next(i for i, s2 in enumerate(res.sims) if s2 is s)
                 fids[1 + idx] = "full"  # superseded by the final batch
+        # rows the learned screen answered from the MODEL carry no device
+        # measurement at all — tag them fid=model (inert to every reader,
+        # like screen rows) so the archive never passes predictions off as
+        # measurements
+        if surrogate is not None:
+            for i, s in enumerate(res.sims):
+                if fids[1 + i] == "screen" and search_bench.was_predicted(
+                        s.order):
+                    fids[1 + i] = "model"
         # screen rows cannot shadow full-fidelity twins on replay:
         # CsvBenchmarker only admits "full" rows into its equivalence cache
         rows = [
             result_row(i, r, o, fidelity=None if f == "full" else f)
             for i, (r, o, f) in enumerate(zip(results, orders, fids))
         ]
+        # THE dump invariant every downstream reader trusts (recorded.py
+        # naive_anchor_of, learn/dataset.py): row 0 is the naive schedule at
+        # FINAL fidelity — checked at dump time (a real exception, not an
+        # assert: it must hold under python -O too) so a future reshuffle of
+        # the results list cannot silently poison every in-file ratio
+        # computed against this file's anchor
+        if orders[0] is not naive_seq or fids[0] != "full":
+            raise RuntimeError(
+                "dump-csv invariant violated: row 0 must be the naive "
+                "schedule at full fidelity")
         with open(args.dump_csv, "w") as f:
             f.write("\n".join(rows) + "\n")
         sys.stderr.write(f"csv: {args.dump_csv} ({len(rows)} rows)\n")

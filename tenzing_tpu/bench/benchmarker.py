@@ -59,6 +59,20 @@ def schedule_id(order) -> str:
     return short_digest(payload)
 
 
+def candidate_failed(where: str, order, exc: BaseException) -> None:
+    """Structured record of a candidate schedule that failed to compile/run:
+    a ``search.candidate_failed`` trace event carrying the schedule id and
+    the exception class, plus a counter — failed candidates are attributable
+    in the trace instead of vanishing into a stderr note.  Shared by every
+    solver's reject path (hill-climb, MCTS rollout/confirm)."""
+    get_metrics().counter("search.candidate_failed").inc()
+    tr = get_tracer()
+    if tr.enabled:
+        tr.event("search.candidate_failed", where=where,
+                 schedule=schedule_id(order), error=type(exc).__name__,
+                 message=str(exc)[:200])
+
+
 @dataclass
 class BenchResult:
     """Percentile statistics of per-iteration wall time in seconds
@@ -421,17 +435,27 @@ def result_row(idx: int, res: BenchResult, order: Sequence,
     (reference mcts.cpp:13-31 / dfs.cpp:84-105 dump format).  ``fidelity``
     (e.g. "screen" for a cheap multi-fidelity measurement) inserts a
     ``fid=<tag>`` cell before the ops — readable by CsvBenchmarker, invisible
-    to rows that omit it, so legacy databases parse unchanged."""
+    to rows that omit it, so legacy databases parse unchanged.  The tag has
+    no escape mechanism, so one containing the cell delimiter would silently
+    truncate and leave its tail masquerading as a malformed op cell —
+    rejected here instead."""
     import json
+
+    if fidelity is not None and CSV_DELIM in fidelity:
+        raise ValueError(
+            f"fidelity tag {fidelity!r} contains the CSV delimiter")
 
     cells = [
         str(idx),
-        repr(res.pct01),
-        repr(res.pct10),
-        repr(res.pct50),
-        repr(res.pct90),
-        repr(res.pct99),
-        repr(res.stddev),
+        # float() first: a numpy scalar's repr ("np.float64(...)") would not
+        # parse back, and CsvBenchmarker(strict=False) would silently skip
+        # the row; plain-float repr round-trips exactly
+        repr(float(res.pct01)),
+        repr(float(res.pct10)),
+        repr(float(res.pct50)),
+        repr(float(res.pct90)),
+        repr(float(res.pct99)),
+        repr(float(res.stddev)),
     ] + ([f"fid={fidelity}"] if fidelity is not None else []) + [
         # '|' can only occur inside JSON strings; the \\u007c escape keeps the
         # cell valid JSON while making the row safely splittable on the delimiter

@@ -52,6 +52,14 @@ from tenzing_tpu.core.sync_ops import (
 )
 
 
+# Engine classification of transfer-post op kinds — shared with the learned
+# surrogate's featurizer (learn/features.py), which must bucket comm bytes by
+# the SAME engine the analytic model queues them on.
+ICI_KINDS = ("permute_start", "all_to_all_start", "psum_start",
+             "rdma_copy_start", "rdma_shift_start")
+PCIE_KINDS = ("host_spill_start", "host_fetch_start")
+
+
 @dataclass(frozen=True)
 class ModelEnv:
     """Machine parameters of the analytic model."""
@@ -109,10 +117,9 @@ class AnalyticBenchmarker:
         env = self.env
         src = self._io(op, "reads")
         size = self._bytes_of(src)
-        if kind in ("host_spill_start", "host_fetch_start"):
+        if kind in PCIE_KINDS:
             return "pcie", size / env.pcie_bw
-        if kind in ("permute_start", "all_to_all_start", "psum_start",
-                    "rdma_copy_start", "rdma_shift_start"):
+        if kind in ICI_KINDS:
             # psum/all_to_all move ~one full buffer per hop in a ring model;
             # a single modeled hop keeps the model simple and monotone
             return "ici", env.ici_lat + size / env.ici_bw

@@ -16,7 +16,7 @@ discoveries worth carrying (observed: the r4k 2.48x winner recorded in a
 
 from typing import List, Optional, Tuple
 
-from tenzing_tpu.bench.benchmarker import CSV_DELIM, CsvBenchmarker
+from tenzing_tpu.bench.benchmarker import CSV_DELIM, CsvBenchmarker, split_fidelity
 from tenzing_tpu.core.schedule import remove_redundant_syncs
 from tenzing_tpu.core.sequence import Sequence, canonical_key
 
@@ -24,11 +24,20 @@ from tenzing_tpu.core.sequence import Sequence, canonical_key
 def naive_anchor_of(path: str) -> Optional[float]:
     """The file's row-0 pct50, read numerically — the naive ops themselves
     may not resolve against a later graph (recorded pre-menu), but the
-    anchor only needs the number.  None if the file has no row-0 anchor."""
+    anchor only needs the number.  None if the file has no row-0 anchor, or
+    if row 0 carries a non-"full" fidelity tag: a screen-floor naive was
+    measured ~100x off the regime every other anchor represents, and an
+    off-regime anchor would corrupt every in-file ratio computed against it
+    (the dump side asserts the same invariant — bench.py --dump-csv)."""
     with open(path) as f:
         first = f.readline().split(CSV_DELIM)
     try:
-        return float(first[3]) if first and first[0] == "0" else None
+        if not first or first[0] != "0":
+            return None
+        fid, _ = split_fidelity([c.strip() for c in first])
+        if fid != "full":
+            return None
+        return float(first[3])
     except (ValueError, IndexError):
         return None
 

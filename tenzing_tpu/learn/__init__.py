@@ -1,0 +1,43 @@
+"""Learned schedule-cost surrogate trained on the measurement corpus.
+
+The project's accumulated search databases (``bench.py --dump-csv``) and
+telemetry bundles (``--trace-out``) are training data: this package turns
+them into a model that predicts which schedules are fast *before* paying
+the ~3.4 s compile+measure per candidate, and wires that prediction into
+the solvers as a screen/confirm benchmarker.
+
+Modules:
+
+* :mod:`tenzing_tpu.learn.dataset` — corpus ingestion: CSV databases +
+  trace bundles -> regime-normalized rows keyed by ``canonical_key``;
+* :mod:`tenzing_tpu.learn.features` — deterministic schedule
+  featurization (op mix, lane occupancy, comm bytes per engine, analytic
+  makespan);
+* :mod:`tenzing_tpu.learn.model` — pure-numpy ridge + bootstrap ensemble:
+  prediction **and** uncertainty, JSON save/load;
+* :mod:`tenzing_tpu.learn.surrogate` — ``SurrogateBenchmarker`` (model as
+  a Benchmarker) and ``ScreeningBenchmarker`` (prescreen + escalate to the
+  wrapped empirical benchmarker).
+
+Workflow: ``docs/learn.md``.  CLI: ``bench.py --learn-train`` /
+``--learn-model`` / ``--learn-screen``.
+"""
+
+from tenzing_tpu.learn.dataset import Corpus, CorpusRow
+from tenzing_tpu.learn.features import FEATURE_NAMES, featurize
+from tenzing_tpu.learn.model import RidgeEnsemble, spearman
+from tenzing_tpu.learn.surrogate import (
+    ScreeningBenchmarker,
+    SurrogateBenchmarker,
+)
+
+__all__ = [
+    "Corpus",
+    "CorpusRow",
+    "FEATURE_NAMES",
+    "RidgeEnsemble",
+    "ScreeningBenchmarker",
+    "SurrogateBenchmarker",
+    "featurize",
+    "spearman",
+]

@@ -1,0 +1,131 @@
+"""Deterministic schedule featurization for the learned cost surrogate.
+
+A schedule maps to a fixed-length float vector whose coordinates are named by
+:data:`FEATURE_NAMES` — the feature contract the trained model is saved
+against (model JSON carries the names; loading refuses a vector mismatch, so
+a model trained under one featurizer version cannot silently mis-predict
+under another).
+
+Feature families ("Machine Learning for CUDA+MPI Design Rules", PAPERS.md —
+the design-rule features there are exactly op-mix + placement + comm-volume
+summaries of a schedule):
+
+* **op-kind counts** — device ops, host data ops, each scheduler-inserted
+  sync kind, each transfer-post kind (the vocabulary is the serdes
+  ``KIND`` registry subset the search actually emits);
+* **lane occupancy** — distinct lanes used, the busiest lane's device-op
+  count, and the busy-lane fraction (1.0 = fully serial), the placement
+  signal that separates overlapped from serialized schedules;
+* **menu choices** — counts of kernel/engine suffix markers in op names
+  (``.pallas`` / ``.xla`` / ``.rdma`` / ``.host`` / ``bf16``): which
+  implementation the searched ChoiceOps resolved to;
+* **comm bytes per engine** — bytes posted through the ICI vs the PCIe
+  engine, classified by the SAME kind sets the analytic model queues on
+  (bench/model.py ICI_KINDS/PCIE_KINDS);
+* **analytic makespan** — the modeled makespan from
+  :class:`~tenzing_tpu.bench.model.AnalyticBenchmarker` (raw and log), the
+  strongest single prior: the learned model only has to fit the residual
+  between the roofline model and the measured corpus.
+
+Everything is a pure function of (sequence, nbytes map, ModelEnv) — no
+randomness, no device — so feature vectors computed at train time and at
+search time agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from tenzing_tpu.bench.model import (
+    ICI_KINDS,
+    PCIE_KINDS,
+    AnalyticBenchmarker,
+    ModelEnv,
+)
+from tenzing_tpu.core.operation import BoundDeviceOp
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.sync_ops import SyncOp
+
+# sync + transfer kinds counted individually (a stable, ordered vocabulary:
+# appending here is a feature-contract change and invalidates saved models,
+# which the names-check in learn/model.py turns into a loud load error)
+_SYNC_KINDS = ("event_record", "wait_event", "event_sync", "lane_sync",
+               "lane_wait")
+_XFER_KINDS = ICI_KINDS + PCIE_KINDS + ("await_transfer", "multi_await")
+# menu-choice markers in op names (the ChoiceOp resolution the search made)
+_CHOICE_MARKS = (".pallas", ".xla", ".rdma", ".host", "bf16")
+
+FEATURE_NAMES: List[str] = (
+    ["n_ops", "n_device", "n_host_data", "n_sync"]
+    + [f"n_{k}" for k in _SYNC_KINDS]
+    + [f"n_{k}" for k in _XFER_KINDS]
+    + ["n_lanes", "lane_max_occ", "serial_frac"]
+    + [f"n_choice_{m.lstrip('.')}" for m in _CHOICE_MARKS]
+    + ["ici_bytes", "pcie_bytes", "analytic_makespan", "log_analytic"]
+)
+
+
+def _reads(op) -> List[str]:
+    fn = getattr(op, "reads", None)
+    return list(fn()) if callable(fn) else []
+
+
+def featurize(
+    seq: Sequence,
+    nbytes: Optional[Dict[str, int]] = None,
+    env: Optional[ModelEnv] = None,
+    cost_fn=None,
+) -> List[float]:
+    """The feature vector of ``seq``, aligned with :data:`FEATURE_NAMES`.
+
+    ``nbytes`` (buffer name -> byte size) feeds the comm-bytes features and
+    the analytic-makespan feature; an empty/missing map degrades those to
+    op-overhead-only estimates rather than failing — a corpus can be
+    featurized before any buffers exist.  ``env``/``cost_fn`` configure the
+    analytic model exactly as :class:`AnalyticBenchmarker` takes them — a
+    workload with a custom per-op cost hook must featurize with the same
+    hook or the makespan feature silently diverges between train and
+    search."""
+    nbytes = nbytes if nbytes is not None else {}
+    bench = AnalyticBenchmarker(nbytes, env=env, cost_fn=cost_fn)
+    kind_counts: Dict[str, int] = {k: 0 for k in _SYNC_KINDS + _XFER_KINDS}
+    n_device = n_host_data = n_sync = 0
+    lane_occ: Dict[int, int] = {}
+    choice_counts = {m: 0 for m in _CHOICE_MARKS}
+    ici_bytes = pcie_bytes = 0.0
+    for op in seq:
+        kind = getattr(op, "KIND", "")
+        if kind in kind_counts:
+            kind_counts[kind] += 1
+        if isinstance(op, SyncOp):
+            n_sync += 1
+        elif isinstance(op, BoundDeviceOp):
+            n_device += 1
+            lid = op.lane().id
+            lane_occ[lid] = lane_occ.get(lid, 0) + 1
+        elif _reads(op) or (getattr(op, "writes", None)
+                            and callable(op.writes) and op.writes()):
+            n_host_data += 1
+        name = op.name()
+        for m in _CHOICE_MARKS:
+            if m in name:
+                choice_counts[m] += 1
+        sz = float(sum(nbytes.get(n, 0) for n in _reads(op)))
+        if kind in ICI_KINDS:
+            ici_bytes += sz
+        elif kind in PCIE_KINDS:
+            pcie_bytes += sz
+    makespan = bench.makespan(seq)
+    lane_max = max(lane_occ.values(), default=0)
+    out = [float(len(seq)), float(n_device), float(n_host_data),
+           float(n_sync)]
+    out += [float(kind_counts[k]) for k in _SYNC_KINDS]
+    out += [float(kind_counts[k]) for k in _XFER_KINDS]
+    out += [float(len(lane_occ)), float(lane_max),
+            lane_max / n_device if n_device else 1.0]
+    out += [float(choice_counts[m]) for m in _CHOICE_MARKS]
+    out += [ici_bytes, pcie_bytes, makespan,
+            math.log(max(makespan, 1e-12))]
+    assert len(out) == len(FEATURE_NAMES)
+    return out

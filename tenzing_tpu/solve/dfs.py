@@ -43,13 +43,21 @@ class DfsOpts:
     comparisons in the dumped database are honest.  Falls back to one-at-a-time
     benchmarking when the benchmarker has no ``benchmark_batch_times`` (e.g.
     CSV replay) or under a multi-host control plane (the batch path is
-    single-host)."""
+    single-host).
+
+    ``prescreen`` (a ``learn.surrogate.SurrogateBenchmarker``) with
+    ``prescreen_keep > 0`` ranks the enumerated terminals by predicted time
+    and benchmarks only the best ``prescreen_keep`` — exhaustive enumeration
+    with learned triage of the measurement budget (the skipped count lands
+    in the ``learn.prune.dfs_skipped`` counter and the explore span)."""
 
     max_seqs: int = 15000
     bench_opts: BenchOpts = field(default_factory=BenchOpts)
     dump_csv_path: Optional[str] = None
     batch: bool = False
     batch_seed: int = 0
+    prescreen: Optional[object] = None  # learn SurrogateBenchmarker
+    prescreen_keep: int = 0
 
     def to_json(self) -> dict:
         """Provenance stamp of the options (reference dfs.cpp:11-14)."""
@@ -76,7 +84,14 @@ class DfsResult:
     counters: Optional[Counters] = None
 
     def dump_csv(self, path: Optional[str] = None) -> str:
-        rows = [result_row(i, s.result, s.order) for i, s in enumerate(self.sims)]
+        # numbered from 1: row index 0 is reserved for "the naive schedule
+        # at final fidelity" (the bench.py --dump-csv anchor invariant) and
+        # a solver-internal dump has no naive anchor — starting at 1 makes
+        # anchor readers (recorded.naive_anchor_of, learn/dataset.py) treat
+        # these files as anchorless instead of silently anchoring every
+        # in-file ratio to an arbitrary first-enumerated terminal
+        rows = [result_row(i, s.result, s.order)
+                for i, s in enumerate(self.sims, start=1)]
         text = "\n".join(rows) + ("\n" if rows else "")
         if path is not None:
             with open(path, "w") as f:
@@ -284,6 +299,31 @@ def explore(
                     states = enumerate_schedules(graph, platform,
                                                  opts.max_seqs,
                                                  counters=counters)
+                if (opts.prescreen is not None and opts.prescreen_keep > 0
+                        and len(states) > opts.prescreen_keep):
+                    # learned triage: benchmark only the terminals the
+                    # surrogate ranks in the money (stable sort keeps the
+                    # enumeration order as the tiebreak, so equal
+                    # predictions stay deterministic)
+                    with tr.span("learn.prescreen", n_in=len(states),
+                                 keep=opts.prescreen_keep):
+                        ranked = sorted(
+                            range(len(states)),
+                            key=lambda i: opts.prescreen.predict(
+                                states[i].sequence)[0],
+                        )
+                        skipped = len(states) - opts.prescreen_keep
+                        states = [states[i]
+                                  for i in ranked[:opts.prescreen_keep]]
+                    from tenzing_tpu.obs.metrics import get_metrics
+
+                    get_metrics().counter("learn.prune.dfs_skipped").inc(
+                        skipped)
+                    reporter.info(
+                        f"tenzing-tpu: dfs prescreen kept "
+                        f"{len(states)}/{len(states) + skipped} terminals",
+                        kept=len(states), skipped=skipped,
+                    )
                 n = len(states)
             else:
                 states, n = [], 0

@@ -20,7 +20,11 @@ import random as _random
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence as Seq, Tuple
 
-from tenzing_tpu.bench.benchmarker import BenchOpts
+from tenzing_tpu.bench.benchmarker import (
+    BenchOpts,
+    candidate_failed,
+    schedule_id,
+)
 from tenzing_tpu.core.graph import Graph
 from tenzing_tpu.core.sequence import Sequence
 from tenzing_tpu.core.state import (
@@ -31,6 +35,8 @@ from tenzing_tpu.core.state import (
     ExpandOp,
     State,
 )
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs.tracer import get_tracer
 
 
 def phase_policy(platform, phases: Seq[str],
@@ -159,6 +165,13 @@ class LocalOpts:
     an earlier solver through a shared ``CachingBenchmarker`` (cache hit —
     instant, no device time) is likewise free (ADVICE r3).
 
+    ``prescreen`` (a ``learn.surrogate.SurrogateBenchmarker``) prunes
+    neighbors before they are measured: a candidate whose optimistic
+    prediction (``mu - prescreen_z * (sigma_cand + sigma_incumbent)``) is
+    still worse than the incumbent's prediction is skipped without charging
+    the budget — the learned model spends the measurement budget on
+    neighbors it cannot rule out.
+
     ``paired=True`` makes each accept decision DRIFT-IMMUNE: the neighbor and
     the current incumbent are measured back-to-back as one decorrelated
     2-schedule batch and the move is taken only when the paired ratio's
@@ -175,6 +188,8 @@ class LocalOpts:
     seed: int = 0
     max_alts_per_step: int = 3
     paired: bool = False
+    prescreen: Optional[object] = None  # learn SurrogateBenchmarker
+    prescreen_z: float = 2.0
 
 
 @dataclass
@@ -216,6 +231,7 @@ def hill_climb(
         except Exception as e:
             import sys
 
+            candidate_failed("local.measure", seq_, e)
             sys.stderr.write(
                 "hill-climb: schedule rejected (failed to compile/run: "
                 f"{type(e).__name__}: {str(e)[:200]})\n"
@@ -247,6 +263,7 @@ def hill_climb(
         except Exception as e:  # compile/runtime failure of the candidate
             import sys
 
+            candidate_failed("local.paired", cand_seq, e)
             sys.stderr.write(
                 "hill-climb: neighbor rejected (failed to compile/run: "
                 f"{type(e).__name__}: {str(e)[:200]})\n"
@@ -301,6 +318,19 @@ def hill_climb(
                     # WITHOUT charging the budget
                     continue
                 seen.add(key)
+                if opts.prescreen is not None:
+                    mu_c, s_c = opts.prescreen.predict(cand_seq)
+                    mu_i, s_i = opts.prescreen.predict(seq)
+                    if mu_c - opts.prescreen_z * (s_c + s_i) > mu_i:
+                        # even the optimistic bound is worse than the
+                        # incumbent's prediction: prune without measuring
+                        get_metrics().counter(
+                            "learn.prune.local_skipped").inc()
+                        tr = get_tracer()
+                        if tr.enabled:
+                            tr.event("learn.prune", where="local",
+                                     schedule=schedule_id(cand_seq))
+                        continue
                 if use_paired:
                     res, accept = paired_step(seq, cand_seq)
                     spent += 1

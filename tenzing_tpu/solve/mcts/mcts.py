@@ -20,6 +20,7 @@ from tenzing_tpu.bench.benchmarker import (
     BenchOpts,
     BenchResult,
     CachingBenchmarker,
+    candidate_failed,
     result_row,
     schedule_id,
 )
@@ -97,10 +98,13 @@ class MctsResult:
     def dump_csv(self, path: Optional[str] = None) -> str:
         rows = [
             # "full" rows keep the legacy 7+ops format; only screened rows
-            # carry the explicit fidelity cell
+            # carry the explicit fidelity cell.  Numbered from 1: row 0 is
+            # reserved for the naive-at-final-fidelity anchor (bench.py
+            # --dump-csv), which a solver-internal dump does not have —
+            # anchor readers then treat these files as anchorless
             result_row(i, s.result, s.order,
                        fidelity=None if s.fidelity == "full" else s.fidelity)
-            for i, s in enumerate(self.sims)
+            for i, s in enumerate(self.sims, start=1)
         ]
         text = "\n".join(rows) + ("\n" if rows else "")
         if path is not None:
@@ -290,6 +294,7 @@ def explore(
                             # propagate (a crash beats a collective deadlock).
                             if cp.size() > 1:
                                 raise
+                            candidate_failed("mcts.rollout", order, e)
                             reporter.warn(
                                 "mcts: rollout rejected (failed to compile/"
                                 f"run: {type(e).__name__}: {str(e)[:200]})",
@@ -367,6 +372,7 @@ def explore(
                     except Exception as e:
                         if cp.size() > 1:
                             raise
+                        candidate_failed("mcts.confirm", order, e)
                         reporter.warn(
                             "mcts: confirm rejected (failed to compile/run: "
                             f"{type(e).__name__}: {str(e)[:200]})",

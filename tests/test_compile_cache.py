@@ -1,0 +1,36 @@
+"""Unit coverage for bench/compile_cache.py (previously untested): the
+persistent-XLA-cache knobs land in jax.config, the TZ_COMPILE_CACHE override
+wins, and the threshold parameter is honored — the CI cache step
+(.github/workflows/ci.yml) keys on this directory staying stable."""
+
+import jax
+import pytest
+
+from tenzing_tpu.bench.compile_cache import enable_compile_cache
+
+
+@pytest.fixture
+def restore_jax_cache_config():
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def test_default_path_and_threshold(monkeypatch, restore_jax_cache_config):
+    monkeypatch.delenv("TZ_COMPILE_CACHE", raising=False)
+    path = enable_compile_cache()
+    assert path == "/tmp/tz_jax_cache"
+    assert jax.config.jax_compilation_cache_dir == path
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 1.0
+
+
+def test_env_override_and_custom_threshold(monkeypatch, tmp_path,
+                                           restore_jax_cache_config):
+    want = str(tmp_path / "cache")
+    monkeypatch.setenv("TZ_COMPILE_CACHE", want)
+    path = enable_compile_cache(min_compile_secs=0.25)
+    assert path == want
+    assert jax.config.jax_compilation_cache_dir == want
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.25

@@ -1,0 +1,140 @@
+"""Round-trip property test for the recorded-database wire format
+(`result_row` / `split_fidelity` / CsvBenchmarker parse,
+tenzing_tpu/bench/benchmarker.py): op payloads containing the ``|`` cell
+delimiter, rows with and without ``fid=`` tags, and numpy-typed stats must
+all survive dump -> parse byte-for-byte.  The corpus ingester
+(learn/dataset.py) and the warm-start loader (bench/recorded.py) both trust
+exactly this contract."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tenzing_tpu.bench.benchmarker import (
+    CSV_DELIM,
+    BenchResult,
+    CsvBenchmarker,
+    result_row,
+    split_fidelity,
+)
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import DeviceOp, Finish, Start
+from tenzing_tpu.core.resources import Lane
+from tenzing_tpu.core.sequence import Sequence, is_equivalent
+
+
+class POp(DeviceOp):
+    def apply(self, bufs, ctx):
+        return {}
+
+
+# names exercising the delimiter escape and JSON string escapes: the '|'
+# cell delimiter, repeated delimiters, quotes, backslashes, unicode
+NASTY_NAMES = [
+    "plain",
+    "a|b",
+    "a|b|c||d",
+    'quo"te',
+    "back\\slash",
+    "pipe|and\"quote\\mix",
+    "unicode|π∆",
+]
+
+
+def _world(names):
+    g = Graph()
+    ops = []
+    for n in names:
+        op = POp(n)
+        ops.append(op)
+        g.start_then(op)
+        g.then_finish(op)
+    return g, ops
+
+
+def _res(vals):
+    p01, p10, p50, p90, p99, sd = vals
+    return BenchResult(pct01=p01, pct10=p10, pct50=p50, pct90=p90,
+                       pct99=p99, stddev=sd)
+
+
+def test_delimiter_in_fidelity_tag_rejected():
+    """The fid cell has no escaping: a tag containing the delimiter would
+    truncate silently and shed a bogus op cell — dump refuses it."""
+    g, ops = _world(["k"])
+    seq = Sequence([ops[0].bind(Lane(0))])
+    with pytest.raises(ValueError, match="delimiter"):
+        result_row(0, _res([1, 2, 3, 4, 5, 0]), seq, fidelity="fid|tricky")
+
+
+@pytest.mark.parametrize("fidelity", [None, "screen"])
+def test_roundtrip_nasty_payloads(fidelity):
+    g, ops = _world(NASTY_NAMES)
+    seq = Sequence([Start()] + [op.bind(Lane(i % 2))
+                                for i, op in enumerate(ops)] + [Finish()])
+    res = _res([1e-5, 2e-5, 3e-5, 4e-5, 5e-5, 1e-6])
+    row = result_row(7, res, seq, fidelity=fidelity)
+    assert "\n" not in row
+    cells = row.split(CSV_DELIM)
+    fid, ops_at = split_fidelity(cells)
+    assert fid == (fidelity if fidelity is not None else "full")
+    assert ops_at == (7 if fidelity is None else 8)
+    db = CsvBenchmarker([row], g)
+    assert len(db.entries) == 1
+    got_seq, got_res = db.entries[0]
+    assert is_equivalent(got_seq, seq)
+    assert db.fidelities == [fid]
+    for f in ("pct01", "pct10", "pct50", "pct90", "pct99", "stddev"):
+        assert getattr(got_res, f) == getattr(res, f)
+    # only full-fidelity rows answer queries (the shadowing rule)
+    if fid == "full":
+        assert db.benchmark(seq).pct50 == res.pct50
+    else:
+        with pytest.raises(KeyError):
+            db.benchmark(seq)
+
+
+def test_roundtrip_property_random_rows():
+    """Seeded property sweep: random name soups (heavy on the delimiter),
+    random float stats (including numpy scalars and exotic magnitudes),
+    random fid tags — parse must reproduce the row exactly."""
+    rng = random.Random(1234)
+    alphabet = 'ab|"\\{}[]:,π \t'
+    for trial in range(40):
+        names = []
+        while len(names) < rng.randint(1, 5):
+            n = "".join(rng.choice(alphabet)
+                        for _ in range(rng.randint(1, 12)))
+            if n not in names:
+                names.append(n)
+        g, ops = _world(names)
+        seq = Sequence([op.bind(Lane(rng.randrange(3))) for op in ops])
+        vals = [rng.choice([1.0, 1e-30, 1e30, 3.141592653589793e-05,
+                            float(np.float64(rng.random()))])
+                for _ in range(6)]
+        # numpy-typed results must round-trip too (repr of np.float64 would
+        # not parse back without the float() cast in result_row)
+        if trial % 2:
+            vals = [np.float64(v) for v in vals]
+        fidelity = rng.choice([None, "screen", "s2"])
+        row = result_row(trial, _res(vals), seq, fidelity=fidelity)
+        fid, _ = split_fidelity(row.split(CSV_DELIM))
+        assert fid == (fidelity if fidelity is not None else "full")
+        db = CsvBenchmarker([row], g)
+        assert len(db.entries) == 1, (names, fidelity)
+        got_seq, got_res = db.entries[0]
+        assert is_equivalent(got_seq, seq), names
+        assert [getattr(got_res, f) for f in
+                ("pct01", "pct10", "pct50", "pct90", "pct99", "stddev")
+                ] == [float(v) for v in vals]
+
+
+def test_legacy_rows_without_fid_cell_parse_as_full():
+    g, ops = _world(["k0", "k1"])
+    seq = Sequence([op.bind(Lane(0)) for op in ops])
+    row = result_row(0, _res([1, 2, 3, 4, 5, 0]), seq)
+    cells = row.split(CSV_DELIM)
+    assert split_fidelity(cells) == ("full", 7)
+    # an op json cell can never be mistaken for a fid tag: it starts with '{'
+    assert cells[7].startswith("{")

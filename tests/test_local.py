@@ -79,6 +79,35 @@ class RiggedBenchmarker:
         return BenchResult(pct01=t, pct10=t, pct50=t, pct90=t, pct99=t, stddev=0.0)
 
 
+def test_failed_candidates_emit_structured_events():
+    """A schedule the benchmarker rejects leaves a search.candidate_failed
+    trace event with the schedule id and exception class, and increments
+    the counter (ISSUE 2 satellite)."""
+    from tenzing_tpu.obs.metrics import MetricsRegistry, get_metrics, set_metrics
+    from tenzing_tpu.obs.tracer import Tracer, set_tracer
+
+    class ExplodingBench:
+        def benchmark(self, order, opts=None):
+            raise ValueError("cannot compile")
+
+    tr = Tracer(enabled=True)
+    prev_tr = set_tracer(tr)
+    prev_reg = set_metrics(MetricsRegistry())
+    try:
+        g, plat, _ = mk()
+        with pytest.raises(RuntimeError, match="nothing to climb from"):
+            hill_climb(g, plat, ExplodingBench(), PHASES,
+                       opts=LocalOpts(budget=4, seed=0))
+        evs = [e for e in tr.events() if e.name == "search.candidate_failed"]
+        assert evs and evs[0].attrs["where"] == "local.measure"
+        assert evs[0].attrs["error"] == "ValueError"
+        assert evs[0].attrs["schedule"]
+        assert get_metrics().counter("search.candidate_failed").value == 1
+    finally:
+        set_tracer(prev_tr)
+        set_metrics(prev_reg)
+
+
 def test_hill_climb_discovers_the_rigged_optimum_direction():
     g, plat, _ = mk()
     bench = CachingBenchmarker(RiggedBenchmarker())

@@ -135,6 +135,32 @@ def test_tree_dump_and_counters(tmp_path):
     assert res.counters.report().startswith("phase counters:")
 
 
+def test_rejected_rollouts_emit_candidate_failed_events():
+    """A rollout whose schedule fails to compile/run must leave a structured
+    search.candidate_failed event (schedule id + exception class) in the
+    trace, not just a stderr note (ISSUE 2 satellite)."""
+    from tenzing_tpu.obs.tracer import Tracer, set_tracer
+
+    class ExplodingBench:
+        def benchmark(self, order, opts=None):
+            raise RuntimeError("liveness exceeds device memory")
+
+    tr = Tracer(enabled=True)
+    prev = set_tracer(tr)
+    try:
+        g = two_indep_device_graph()
+        res = explore(g, FakePlatform(2), ExplodingBench(),
+                      MctsOpts(n_iters=6, seed=0))
+        assert res.sims == []  # every rollout rejected, none recorded
+        evs = [e for e in tr.events() if e.name == "search.candidate_failed"]
+        assert evs
+        assert evs[0].attrs["where"] == "mcts.rollout"
+        assert evs[0].attrs["error"] == "RuntimeError"
+        assert evs[0].attrs["schedule"]  # attributable schedule id
+    finally:
+        set_tracer(prev)
+
+
 def test_expand_rollout_materializes_tree():
     g = two_indep_device_graph()
     r_noexp = explore(

@@ -75,6 +75,35 @@ def test_missing_anchor_and_unreadable_file(tmp_path):
     assert any("carrying top 0" in m for m in msgs)
 
 
+def test_naive_anchor_rejects_screen_fidelity_row0(tmp_path):
+    """A file whose row 0 is a SCREEN-fidelity naive has no regime-honest
+    anchor: its ~100x-cheaper measurement floor would corrupt every in-file
+    ratio, so naive_anchor_of must return None (the dump side asserts the
+    row-0-is-full-naive invariant at write time, bench.py --dump-csv)."""
+    naive = naive_order(ARGS, Platform.make_n_lanes(1))
+    screen0 = tmp_path / "screen0.csv"
+    screen0.write_text(
+        result_row(0, _res(0.001), naive, fidelity="screen") + "\n")
+    assert naive_anchor_of(str(screen0)) is None
+    full0 = tmp_path / "full0.csv"
+    full0.write_text(result_row(0, _res(0.1), naive) + "\n")
+    assert naive_anchor_of(str(full0)) == 0.1
+    # explicit fid=full tag is equivalent to the legacy untagged row
+    tagged = tmp_path / "tagged.csv"
+    tagged.write_text(result_row(0, _res(0.2), naive, fidelity="full") + "\n")
+    assert naive_anchor_of(str(tagged)) == 0.2
+    # and rank_recorded treats the screen-anchored file as anchorless
+    g = build_graph(ARGS)
+    rows = [result_row(0, _res(0.001), naive, fidelity="screen")]
+    plat = Platform.make_n_lanes(2)
+    seqs = [st.sequence for st in get_all_sequences(build_graph(ARGS), plat,
+                                                    max_seqs=2)]
+    rows.append(result_row(1, _res(0.0001), seqs[0]))
+    db = tmp_path / "screendb.csv"
+    db.write_text("\n".join(rows) + "\n")
+    assert rank_recorded([str(db)], g, topk=3) == []
+
+
 def test_stale_rows_skipped_against_narrower_graph(tmp_path):
     """Rows recorded against the menu graph deserialize against the same
     graph; rows from a DIFFERENT structural variant are skipped, not fatal."""
