@@ -53,23 +53,32 @@ import time
 
 
 def probe_backend(retries: int = 1, wait_secs: float = 15.0):
-    """Initialize the JAX backend, retrying once on transient tunnel failure.
-    Returns the device list; raises after the final retry."""
+    """Initialize the JAX backend, retrying on transient tunnel failure via
+    the shared backoff helper (fault/backoff.py — each retry lands as a
+    ``fault.retry`` obs event with attempt count and error class).  Returns
+    the device list; raises after the final retry."""
     import jax
 
-    for attempt in range(retries + 1):
-        try:
-            return jax.devices()
-        except RuntimeError as e:
-            sys.stderr.write(f"backend init failed (attempt {attempt + 1}): {e}\n")
-            if attempt == retries:
-                raise
-            time.sleep(wait_secs)
-            # a failed init is cached; clear and retry once
-            import jax.extend as jex
+    from tenzing_tpu.fault.backoff import BackoffPolicy, retry_call
 
-            jex.backend.clear_backends()
-    raise AssertionError("unreachable")  # pragma: no cover
+    def on_retry(e, attempt, delay):
+        sys.stderr.write(f"backend init failed (attempt {attempt + 1}): {e}\n")
+        # a failed init is cached; clear and retry fresh
+        import jax.extend as jex
+
+        jex.backend.clear_backends()
+
+    return retry_call(
+        jax.devices,
+        policy=BackoffPolicy(retries=retries, base_secs=wait_secs,
+                             factor=2.0, jitter=0.25),
+        # the legacy probe retried any RuntimeError from backend init —
+        # broader than the transient-only default, and right here: an init
+        # failure is a tunnel/plugin problem, never a broken schedule
+        retry_on=lambda e: isinstance(e, RuntimeError),
+        where="backend.init",
+        on_retry=on_retry,
+    )
 
 
 # the measured per-face aliased-unpack recipe (the r5 discovery, see
@@ -266,7 +275,39 @@ def main() -> int:
                          "surrogate, escalating only plausible-top-k / "
                          "uncertain candidates to the device; also prunes "
                          "hill-climb neighbors the model can rule out")
+    ap.add_argument("--checkpoint", default=None, metavar="DIR",
+                    help="checkpoint directory (docs/robustness.md): the "
+                         "measurement journal is appended as each "
+                         "measurement lands, solver cursors snapshot "
+                         "atomically, deterministic-failure quarantine "
+                         "persists, and SIGINT writes a final snapshot")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the --checkpoint journal into the "
+                         "benchmark cache before searching: already-"
+                         "measured schedules never touch the device again "
+                         "and the deterministic search reconstructs to the "
+                         "kill point")
+    ap.add_argument("--measure-timeout", type=float, default=None,
+                    metavar="SECS",
+                    help="watchdog wall-clock bound per measurement: a hung "
+                         "compile/fetch surfaces as a transient timeout "
+                         "(retried with backoff) instead of blocking the "
+                         "search forever")
+    ap.add_argument("--inject-faults", default=None,
+                    metavar="KIND:RATE:SEED[,...]",
+                    help="seeded chaos (fault/inject.py): deterministically "
+                         "inject transient errors / hangs / deterministic "
+                         "failures / device loss into every measurement "
+                         "(kinds: transient, hang, deterministic, "
+                         "device_lost)")
+    ap.add_argument("--inject-hang-secs", type=float, default=60.0,
+                    help="how long an injected hang stalls (pair with "
+                         "--measure-timeout to exercise the watchdog)")
     args = ap.parse_args()
+    if args.resume and not args.checkpoint:
+        # silently ignoring --resume would re-measure a multi-hour search
+        # from scratch while the output JSON claims a resume happened
+        ap.error("--resume requires --checkpoint DIR")
 
     if args.smoke:
         import jax
@@ -310,9 +351,14 @@ def main() -> int:
                 os.path.join(args.trace_out, f"trace{sfx}.json"))
             sys.stderr.write(f"trace bundle: {args.trace_out}\n")
         if args.metrics_json:
+            # block=False: this runs from the signal trap, where the
+            # interrupted thread may hold an instrument lock — the
+            # non-blocking read falls back to GIL-atomic copies instead of
+            # deadlocking the Ctrl-C path (the exporters above are
+            # non-blocking by construction, obs/export.py)
             with open(args.metrics_json + sfx, "w") as f:
-                json.dump(obs.get_metrics().to_json(), f, indent=2,
-                          sort_keys=True)
+                json.dump(obs.get_metrics().to_json(block=False), f,
+                          indent=2, sort_keys=True)
             sys.stderr.write(f"metrics: {args.metrics_json}{sfx}\n")
 
     if args.trace_out or args.metrics_json:
@@ -430,7 +476,76 @@ def main() -> int:
         args.mcts_iters = min(args.mcts_iters, 12)
     ex = TraceExecutor(plat, bufs)
     emp = EmpiricalBenchmarker(ex)
-    bench = CachingBenchmarker(emp)
+    # fault-tolerance stack (docs/robustness.md), inside-out:
+    #   EmpiricalBenchmarker            device measurement
+    #   [FaultInjectingBenchmarker]     --inject-faults seeded chaos
+    #   ResilientBenchmarker            watchdog / classified retry /
+    #                                   quarantine / degradation
+    #   [JournalingBenchmarker]         --checkpoint measurement journal
+    #   CachingBenchmarker              equivalence-keyed cache (also the
+    #                                   --resume restore target)
+    from tenzing_tpu.fault import (
+        JournalingBenchmarker,
+        Quarantine,
+        ResilientBenchmarker,
+        SearchCheckpoint,
+    )
+
+    measured_stack = emp
+    injector = None
+    if args.inject_faults:
+        from tenzing_tpu.fault import FaultInjectingBenchmarker, parse_inject_specs
+
+        injector = FaultInjectingBenchmarker(
+            emp, parse_inject_specs(args.inject_faults),
+            hang_secs=args.inject_hang_secs)
+        measured_stack = injector
+        sys.stderr.write(f"chaos: injecting {args.inject_faults}\n")
+    ckpt = SearchCheckpoint(args.checkpoint) if args.checkpoint else None
+    quar = Quarantine(ckpt.quarantine_path if ckpt else None,
+                      log=lambda m: sys.stderr.write(m + "\n"))
+    if len(quar):
+        sys.stderr.write(
+            f"quarantine: {len(quar)} schedule(s) carried from previous "
+            "runs will not be re-measured\n")
+    resilient = ResilientBenchmarker(
+        measured_stack, timeout_secs=args.measure_timeout, quarantine=quar,
+        fallback=surrogate)
+    bench = CachingBenchmarker(
+        JournalingBenchmarker(resilient, ckpt) if ckpt else resilient)
+    if ckpt is not None:
+        config = {"workload": args.workload, "metric": metric,
+                  "smoke": bool(args.smoke), "seed_topk": args.seed_topk}
+        prior = None
+        try:
+            prior = ckpt.load_state()
+        except Exception as e:  # corrupt snapshot: resume from journal only
+            sys.stderr.write(f"checkpoint: state unreadable ({e}); "
+                             "journal + quarantine still apply\n")
+        if prior is not None and prior.get("config") not in (None, config):
+            sys.stderr.write(
+                "checkpoint: recorded config differs from this run "
+                f"({prior.get('config')} vs {config}); journal rows that "
+                "do not resolve against this workload are skipped\n")
+        if args.resume:
+            restored = ckpt.restore_into(
+                bench, g, log=lambda m: sys.stderr.write(m + "\n"))
+            sys.stderr.write(
+                f"resume: {restored} recorded measurement(s) restored — "
+                "already-measured schedules will not touch the device\n")
+        ckpt.save_state(config=config)
+
+        # final snapshots: the journal and quarantine are already on disk
+        # (appended/rewritten as each measurement landed), so these only
+        # stamp the cursor document.  The trap path marks the interrupt
+        # (SIG_DFL then kills without running atexit); a normal exit marks
+        # completion.
+        import atexit as _atexit
+
+        from tenzing_tpu.utils import trap as _trap
+
+        _atexit.register(lambda: ckpt.save_state(done=True))
+        _trap.register_handler(lambda: ckpt.save_state(interrupted=True))
     # max_retries=2 (library default 10): the runs-test retry loop re-measures
     # the whole series on rejection, and in the tunnel's slow regime that blew
     # a single naive benchmark to 558 s of wall; the verdict comes from the
@@ -682,21 +797,23 @@ def main() -> int:
             log=lambda m: sys.stderr.write(m + "\n"),
         )
         recorded_ok = []
+        from tenzing_tpu.fault.backoff import BackoffPolicy as _BP, retry_call
+
         for ri, (seq_r, ratio) in enumerate(picked):
             t0 = time.time()
-            meas = None
-            err = None
-            for attempt in (0, 1):  # one retry: the tunnel has flaky spells
-                try:
-                    meas = bench.benchmark(seq_r, search_opts)
-                    break
-                except Exception as e:
-                    err = e
-            if meas is None:
+            # transient-classified retry via the shared backoff helper (the
+            # tunnel has flaky spells); a deterministic failure — a recorded
+            # schedule this chip genuinely cannot run — drops immediately
+            try:
+                meas = retry_call(
+                    lambda seq_r=seq_r: bench.benchmark(seq_r, search_opts),
+                    policy=_BP(retries=1, base_secs=2.0),
+                    where="recorded.warmstart",
+                )
+            except Exception as err:
                 sys.stderr.write(
-                    f"recorded[{ri}] dropped after retry "
-                    f"({type(err).__name__ if err else 'unknown'}:"
-                    f" {str(err)[:200]})\n"
+                    f"recorded[{ri}] dropped "
+                    f"({type(err).__name__}: {str(err)[:200]})\n"
                 )
                 continue
             sys.stderr.write(
@@ -759,7 +876,8 @@ def main() -> int:
         search_bench,
         MctsOpts(n_iters=args.mcts_iters, bench_opts=mcts_confirm,
                  screen_opts=mcts_screen, confirm_topk=4, seed=0,
-                 rollout_policy=mcts_rollout_policy),
+                 rollout_policy=mcts_rollout_policy,
+                 checkpoint=ckpt),
         strategy=FastMin,
         seeds=seed_paths,
     )
@@ -902,7 +1020,7 @@ def main() -> int:
                 g, cplat, bench, cphases, prefer=cprefer, priority=cpriority,
                 opts=LocalOpts(budget=cbudget, bench_opts=climb_opts,
                                seed=2 + ci, paired=True,
-                               prescreen=surrogate),
+                               prescreen=surrogate, checkpoint=ckpt),
             )
             lbest = lres.best()
             sys.stderr.write(
@@ -945,8 +1063,10 @@ def main() -> int:
 
     def batch_paired(seqs, bopts, seed):
         """(results, paired-vs-naive) for [naive] + candidates run as one
-        decorrelated batch."""
-        times = emp.benchmark_batch_times([naive_seq] + list(seqs), bopts, seed=seed)
+        decorrelated batch — through the resilient wrapper, so a tunnel
+        flake mid-verdict retries the batch instead of killing the run."""
+        times = resilient.benchmark_batch_times(
+            [naive_seq] + list(seqs), bopts, seed=seed)
         results = [BenchResult.from_times(ts) for ts in times]
         paired = [paired_speedup(times[0], ts, seed=seed + 1) for ts in times[1:]]
         return results, paired
@@ -1008,6 +1128,17 @@ def main() -> int:
     value_us = naive.pct50 * 1e6
     finals = []
     top = []
+    if resilient.degraded:
+        # graceful degradation (docs/robustness.md): the device was lost
+        # mid-search and the run finished against cache + surrogate.  The
+        # paired screen/final need live hardware, and a verdict from
+        # predicted numbers must never pass as a measurement — report the
+        # pre-loss naive measurement with vs_baseline 1.0 and degraded
+        # provenance instead of a fabricated win.
+        sys.stderr.write(
+            "degraded: device lost mid-search — skipping the paired "
+            "screen/final; reporting no-win with degraded provenance\n")
+        cands = []
     # constructed unconditionally: the regime metadata in the final JSON
     # reads the ACTUAL floors these carry, so tuning a multiplier at one
     # site cannot silently desynchronize the reported metadata
@@ -1129,6 +1260,14 @@ def main() -> int:
                 if fids[1 + i] == "screen" and search_bench.was_predicted(
                         s.order):
                     fids[1 + i] = "model"
+        # rows answered after device loss carry degraded provenance — like
+        # fid=model they are inert to every reader (CsvBenchmarker admits
+        # only "full" rows, recorded.py skips non-"full"), so a degraded
+        # run's archive can never pass predictions off as measurements
+        if resilient.degraded:
+            for i, s in enumerate(res.sims):
+                if resilient.was_degraded(s.order):
+                    fids[1 + i] = "degraded"
         # screen rows cannot shadow full-fidelity twins on replay:
         # CsvBenchmarker only admits "full" rows into its equivalence cache
         rows = [
@@ -1163,6 +1302,18 @@ def main() -> int:
                          if top and finals and vs > 1.0 else None),
         "recorded_seeds": len(recorded),
     }
+    # fault-layer provenance (ISSUE 3): a degraded verdict or a quarantine
+    # -heavy run must be visible in the parsed metric series, not only in
+    # stderr.  ``resumed`` distinguishes a continued run's numbers (its
+    # search-phase measurements may predate the current chip regime).
+    if resilient.degraded or len(quar) or args.resume or injector is not None:
+        meta["fault"] = {
+            "degraded": resilient.degraded,
+            "quarantined": len(quar),
+            "resumed": bool(args.resume),
+            **({"injected": {k: v for k, v in injector.injected.items() if v}}
+               if injector is not None else {}),
+        }
     write_telemetry()
     print(
         json.dumps(

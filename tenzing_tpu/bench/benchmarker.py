@@ -61,15 +61,22 @@ def schedule_id(order) -> str:
 
 def candidate_failed(where: str, order, exc: BaseException) -> None:
     """Structured record of a candidate schedule that failed to compile/run:
-    a ``search.candidate_failed`` trace event carrying the schedule id and
-    the exception class, plus a counter — failed candidates are attributable
-    in the trace instead of vanishing into a stderr note.  Shared by every
-    solver's reject path (hill-climb, MCTS rollout/confirm)."""
+    a ``search.candidate_failed`` trace event carrying the schedule id, the
+    exception class, and the fault taxonomy class (fault/errors.py —
+    transient flake vs deterministic broken candidate vs device loss), plus
+    a counter — failed candidates are attributable in the trace instead of
+    vanishing into a stderr note.  Shared by every solver's reject path
+    (hill-climb, MCTS rollout/confirm, DFS)."""
+    # lazy import: fault.resilient imports this module, so a top-level
+    # import here would cycle
+    from tenzing_tpu.fault.errors import classify_error
+
     get_metrics().counter("search.candidate_failed").inc()
     tr = get_tracer()
     if tr.enabled:
         tr.event("search.candidate_failed", where=where,
                  schedule=schedule_id(order), error=type(exc).__name__,
+                 error_class=classify_error(exc),
                  message=str(exc)[:200])
 
 
@@ -381,6 +388,11 @@ class CachingBenchmarker:
         self._cache: dict = {}
         self.hits = 0
         self.misses = 0
+        # a cache in front of a rank-coherent benchmarker is itself rank
+        # -coherent: hits are local (identical on every rank — the broadcast
+        # order and the restored journal agree rank-to-rank) and misses
+        # inherit the inner agreement protocol (fault/resilient.py)
+        self.rank_coherent = getattr(inner, "rank_coherent", False)
 
     @staticmethod
     def _key(order: Sequence, opts: Optional[BenchOpts]) -> Tuple:

@@ -1,0 +1,277 @@
+"""Checkpoint/resume for long searches.
+
+A multi-hour empirical search must survive a kill — SIGINT, a SLURM wall
+clock, a crashed tunnel — without losing its corpus.  The checkpoint layout
+(one directory, ``bench.py --checkpoint DIR``):
+
+* ``measurements.jsonl`` — the **measurement journal**: one JSON line per
+  completed device measurement (serialized ops, the BenchOpts fidelity key,
+  the full BenchResult, provenance tag), appended and flushed *as each
+  measurement lands* — crash-safe by construction; a torn tail line (killed
+  mid-write) is detected and skipped on load.
+* ``state.json`` — solver cursors + run config, written **atomically**
+  (tmp + rename) as a versioned, sha256-digest-checked envelope
+  (:func:`atomic_write_json`); a corrupt or version-mismatched file raises
+  :class:`CheckpointError` instead of silently resuming from garbage.
+* ``quarantine.json`` — fault/quarantine.py's persistent broken-candidate
+  set (kept in the same directory so one ``--checkpoint DIR`` carries all
+  cross-restart state).
+
+**Resume model** (docs/robustness.md): the searches are deterministic given
+their seeds and their measurement answers.  ``--resume`` therefore restores
+the journal into the run's equivalence-keyed ``CachingBenchmarker`` and
+re-executes the search from the top: every already-measured schedule is a
+cache hit (zero device time, bit-identical BenchResult — floats round-trip
+exactly through JSON), so the MCTS tree, the DFS frontier walk and the
+hill-climb chain reconstruct *exactly* up to the kill point and continue
+live from there.  No already-measured schedule touches the device again,
+and the final best matches an uninterrupted run (tests/test_chaos_search.py
+asserts both).  The solver cursors in ``state.json`` are consistency
+metadata: resume sanity-checks the workload config digest against them.
+
+Degraded-mode rows are journaled with their provenance but **not**
+restored into the cache: on a healthy resumed device they should be
+re-measured, not replayed as if they were measurements.  (Model-answered
+queries never reach the journal at all — the learned screen wraps
+*outside* the caching/journaling stack, bench.py — but the restore filter
+skips any non-``measured`` provenance, so a journal written by a future
+layer that does tag ``model`` rows degrades safely too.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult
+from tenzing_tpu.obs.metrics import get_metrics
+
+CHECKPOINT_VERSION = 1
+
+# journal provenance tags: only MEASURED rows restore into the cache
+PROVENANCE_MEASURED = "measured"
+PROVENANCE_DEGRADED = "degraded"
+PROVENANCE_MODEL = "model"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file exists but cannot be trusted (bad digest/version)."""
+
+
+def _digest(payload_text: str) -> str:
+    return hashlib.sha256(payload_text.encode()).hexdigest()
+
+
+def atomic_dump_json(path: str, doc: Dict[str, Any],
+                     prefix: str = ".ckpt.") -> None:
+    """THE raw atomic JSON write (tmp + fsync + rename) shared by every
+    fault-layer file writer (state snapshots here, fault/quarantine.py):
+    readers see either the previous complete file or the new complete
+    file, never a torn write, and the rename only lands after the bytes
+    are durably on disk."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
+    """Write ``payload`` as a versioned digest-checked envelope via
+    :func:`atomic_dump_json`."""
+    text = json.dumps(payload, sort_keys=True)
+    atomic_dump_json(path, {"version": CHECKPOINT_VERSION,
+                            "digest": _digest(text), "payload": payload})
+
+
+def read_checked_json(path: str) -> Dict[str, Any]:
+    """Read an :func:`atomic_write_json` envelope, verifying version and
+    digest; raises :class:`CheckpointError` on any mismatch."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"unreadable checkpoint {path}: {e}") from e
+    if doc.get("version") != CHECKPOINT_VERSION:
+        raise CheckpointError(
+            f"checkpoint {path}: version {doc.get('version')!r} != "
+            f"{CHECKPOINT_VERSION}")
+    payload = doc.get("payload")
+    text = json.dumps(payload, sort_keys=True)
+    if _digest(text) != doc.get("digest"):
+        raise CheckpointError(f"checkpoint {path}: digest mismatch "
+                              "(truncated or corrupted)")
+    return payload
+
+
+def _opts_key(opts: Optional[BenchOpts]) -> Optional[List[float]]:
+    if opts is None:
+        return None
+    return [opts.n_iters, opts.max_retries, opts.target_secs]
+
+
+def _opts_from_key(key) -> Optional[BenchOpts]:
+    if key is None:
+        return None
+    return BenchOpts(n_iters=int(key[0]), max_retries=int(key[1]),
+                     target_secs=float(key[2]))
+
+
+def _result_from_json(j: Dict[str, Any]) -> BenchResult:
+    return BenchResult(
+        pct01=j["pct01"], pct10=j["pct10"], pct50=j["pct50"],
+        pct90=j["pct90"], pct99=j["pct99"], stddev=j["stddev"],
+        times=j.get("times"), fetch_overhead=j.get("fetch_overhead"),
+    )
+
+
+class SearchCheckpoint:
+    """One checkpoint directory (see module docstring)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._journal_f = None
+        self._state: Dict[str, Any] = {}
+
+    # -- paths -------------------------------------------------------------
+    @property
+    def state_path(self) -> str:
+        return os.path.join(self.dir, "state.json")
+
+    @property
+    def journal_path(self) -> str:
+        return os.path.join(self.dir, "measurements.jsonl")
+
+    @property
+    def quarantine_path(self) -> str:
+        return os.path.join(self.dir, "quarantine.json")
+
+    # -- measurement journal ------------------------------------------------
+    def record(self, order, opts: Optional[BenchOpts], res: BenchResult,
+               provenance: str = PROVENANCE_MEASURED) -> None:
+        """Append one measurement, flushed immediately (crash-safe)."""
+        from tenzing_tpu.core.serdes import sequence_to_json
+
+        line = json.dumps({
+            "opts": _opts_key(opts),
+            "prov": provenance,
+            "result": res.to_json(),
+            "ops": sequence_to_json(order),
+        }, sort_keys=True)
+        if self._journal_f is None:
+            self._journal_f = open(self.journal_path, "a")
+        self._journal_f.write(line + "\n")
+        self._journal_f.flush()
+        os.fsync(self._journal_f.fileno())
+        get_metrics().counter("fault.checkpoint.journaled").inc()
+
+    def load_measurements(self, graph, log=None) -> List[
+            Tuple[Any, Optional[BenchOpts], BenchResult, str]]:
+        """Parse the journal against ``graph``; returns (sequence, opts,
+        result, provenance) per complete line.  A torn tail line or a row
+        whose ops no longer resolve is skipped with a note — a journal is
+        an optimization, never a correctness gate."""
+        from tenzing_tpu.core.sequence import Sequence
+        from tenzing_tpu.core.serdes import op_from_json
+
+        out = []
+        if not os.path.exists(self.journal_path):
+            return out
+        with open(self.journal_path) as f:
+            for i, line in enumerate(f):
+                if not line.strip():
+                    continue
+                try:
+                    j = json.loads(line)
+                    seq = Sequence(
+                        [op_from_json(oj, graph) for oj in j["ops"]])
+                    out.append((seq, _opts_from_key(j["opts"]),
+                                _result_from_json(j["result"]),
+                                j.get("prov", PROVENANCE_MEASURED)))
+                except Exception as e:
+                    if log is not None:
+                        log(f"checkpoint: journal line {i} skipped "
+                            f"({type(e).__name__}: {str(e)[:120]})")
+        return out
+
+    def restore_into(self, caching, graph, log=None) -> int:
+        """Pre-populate a ``CachingBenchmarker`` from the journal so every
+        already-measured schedule is answered without touching the device.
+        Only device measurements restore (see module docstring); later
+        journal lines win (a re-measurement supersedes).  Returns the
+        number of cache entries installed."""
+        n = 0
+        for seq, opts, res, prov in self.load_measurements(graph, log=log):
+            if prov != PROVENANCE_MEASURED:
+                continue
+            caching._cache[caching._key(seq, opts)] = res
+            n += 1
+        get_metrics().counter("fault.checkpoint.restored").inc(n)
+        return n
+
+    # -- solver-state snapshot ----------------------------------------------
+    def save_state(self, state: Optional[Dict[str, Any]] = None,
+                   **merge: Any) -> None:
+        """Atomically snapshot solver cursors/config.  ``state`` replaces
+        the whole document; keyword arguments merge into the current one —
+        each solver updates only its own cursor key."""
+        if state is not None:
+            self._state = dict(state)
+        self._state.update(merge)
+        atomic_write_json(self.state_path, self._state)
+
+    def load_state(self) -> Optional[Dict[str, Any]]:
+        """The last snapshot, digest-verified; None when absent."""
+        if not os.path.exists(self.state_path):
+            return None
+        self._state = read_checked_json(self.state_path)
+        return dict(self._state)
+
+    def close(self) -> None:
+        if self._journal_f is not None:
+            self._journal_f.close()
+            self._journal_f = None
+
+
+class JournalingBenchmarker:
+    """Records every successful measurement of the wrapped benchmarker into
+    a :class:`SearchCheckpoint` journal.  Sits *inside* the run's
+    ``CachingBenchmarker`` (cache hits are already journaled) and *outside*
+    the resilient wrapper (only measurements that actually completed are
+    journaled; provenance downgraded to ``degraded`` when the resilient
+    layer answered from its fallback)."""
+
+    def __init__(self, inner, checkpoint: SearchCheckpoint):
+        self.inner = inner
+        self.checkpoint = checkpoint
+        self.rank_coherent = getattr(inner, "rank_coherent", False)
+        if hasattr(inner, "benchmark_batch_times"):
+            # batches are the verdict path; their results land in the CSV
+            # dump, not the journal (re-running a final batch on resume is
+            # cheap relative to the search and keeps the verdict fresh)
+            self.benchmark_batch_times = inner.benchmark_batch_times
+
+    def was_degraded(self, order) -> bool:
+        fn = getattr(self.inner, "was_degraded", None)
+        return bool(fn(order)) if fn is not None else False
+
+    def benchmark(self, order, opts: Optional[BenchOpts] = None) -> BenchResult:
+        res = self.inner.benchmark(order, opts)
+        prov = (PROVENANCE_DEGRADED if self.was_degraded(order)
+                else PROVENANCE_MEASURED)
+        self.checkpoint.record(order, opts, res, provenance=prov)
+        return res

@@ -1,0 +1,140 @@
+"""Failure taxonomy of the measurement loop.
+
+The empirical loop — compile a candidate schedule, run it fenced on real
+hardware through a remote PJRT tunnel, reduce across hosts — fails in three
+fundamentally different ways, and each demands a different response
+(docs/robustness.md):
+
+* **transient** — the tunnel dropped an RPC, a socket reset, a watchdog
+  timeout on a hung fetch: *the measurement* failed, not the schedule.
+  Retrying (with backoff, fault/backoff.py) is correct and usually works.
+* **deterministic** — the *schedule* is broken: it does not compile, its
+  liveness exceeds device memory, a shape contract is violated.  Retrying
+  re-pays the failing compile for the same verdict; the candidate is
+  quarantined (fault/quarantine.py) so it is never measured again, even
+  across process restarts.
+* **device_lost** — the chip is gone (reboot, preemption, tunnel torn down
+  for good).  No retry can help; the runtime either degrades to recorded +
+  predicted answers (fault/resilient.py) or aborts.
+
+:func:`classify_error` maps an arbitrary exception to one of these classes.
+Explicit marker types (raised by the fault layer itself and by the
+fault-injection harness) classify by ``isinstance``; everything else by
+exception type and message patterns.  Unknown errors default to
+**deterministic**: an unrecognized failure is most often a broken candidate,
+and mis-classifying a transient as deterministic costs one quarantined
+candidate, while mis-classifying a deterministic as transient costs
+``retries`` failing compiles *per encounter, forever*.
+"""
+
+from __future__ import annotations
+
+
+class FaultClass:
+    """The three failure classes, ordered by severity (the rank-agreement
+    protocol allreduce-maxes the numeric codes, so the *worst* class seen on
+    any rank wins — fault/resilient.py)."""
+
+    OK = "ok"
+    TRANSIENT = "transient"
+    DETERMINISTIC = "deterministic"
+    DEVICE_LOST = "device_lost"
+
+    CODES = {OK: 0, TRANSIENT: 1, DETERMINISTIC: 2, DEVICE_LOST: 3}
+    FROM_CODE = {v: k for k, v in CODES.items()}
+
+
+class TransientError(RuntimeError):
+    """A measurement attempt failed for reasons unrelated to the schedule
+    (tunnel/RPC flake); retry with backoff."""
+
+
+class MeasurementTimeout(TransientError):
+    """The watchdog wall-clock bound fired: the measurement hung (a stuck
+    collective, a dead tunnel that never errors).  Transient — the retry
+    gets a fresh dispatch — but also the deadlock breaker: a rank that
+    would have blocked forever in a barrier instead reports a fault code."""
+
+
+class DeterministicScheduleError(RuntimeError):
+    """The schedule itself is broken (compile/shape/liveness); quarantine."""
+
+
+class QuarantinedScheduleError(DeterministicScheduleError):
+    """Raised instead of re-measuring a schedule already quarantined."""
+
+
+class DeviceLostError(RuntimeError):
+    """The device is unrecoverable; escalate (degrade or abort)."""
+
+
+# message fragments checked lowercase; order matters only across lists
+# (device-lost checked first: "device lost while connection reset" is a loss)
+_DEVICE_LOST_PATTERNS = (
+    "device lost",
+    "device_lost",
+    "device or resource busy",
+    "chip rebooted",
+    "failed to connect to device",
+    "device unreachable",
+)
+_TRANSIENT_PATTERNS = (
+    "deadline exceeded",
+    "deadline_exceeded",
+    "unavailable",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "socket closed",
+    "rpc error",
+    "transient",
+    "temporarily",
+    "timed out",
+    "timeout",
+)
+# deterministic patterns beat the generic transient words when both match
+# ("RESOURCE_EXHAUSTED ... try again" is an OOM, not a flake)
+_DETERMINISTIC_PATTERNS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "invalid_argument",
+    "invalid argument",
+    "unimplemented",
+    "failed to compile",
+    "compilation failure",
+    "shape",
+)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Map an exception to a :class:`FaultClass` string (see module doc)."""
+    if isinstance(exc, DeviceLostError):
+        return FaultClass.DEVICE_LOST
+    if isinstance(exc, DeterministicScheduleError):
+        return FaultClass.DETERMINISTIC
+    if isinstance(exc, TransientError):
+        return FaultClass.TRANSIENT
+    msg = str(exc).lower()
+    for pat in _DEVICE_LOST_PATTERNS:
+        if pat in msg:
+            return FaultClass.DEVICE_LOST
+    for pat in _DETERMINISTIC_PATTERNS:
+        if pat in msg:
+            return FaultClass.DETERMINISTIC
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError)):
+        return FaultClass.TRANSIENT
+    for pat in _TRANSIENT_PATTERNS:
+        if pat in msg:
+            return FaultClass.TRANSIENT
+    if isinstance(exc, OSError):
+        return FaultClass.TRANSIENT
+    # shape/type/value errors from a broken candidate; also the default —
+    # see module docstring for why unknown leans deterministic
+    return FaultClass.DETERMINISTIC
+
+
+def fault_code(exc: BaseException) -> int:
+    """Numeric severity code of an exception's class — what the control
+    plane allreduce-maxes in the rank-agreement protocol."""
+    return FaultClass.CODES[classify_error(exc)]

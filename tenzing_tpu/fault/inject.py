@@ -1,0 +1,144 @@
+"""Seeded fault injection: the chaos substrate for tests and CI.
+
+:class:`FaultInjectingBenchmarker` wraps any benchmarker and injects
+failures *deterministically* from seeded RNGs — the same seed replays the
+same fault schedule, so a chaos run is a reproducible experiment, not a
+flake generator.  Four kinds (``bench.py --inject-faults kind:rate:seed``,
+comma-separated to compose):
+
+* ``transient`` — raises :class:`InjectedTransientError` on a seeded
+  per-call coin flip (classified transient → the resilient wrapper retries).
+* ``hang`` — sleeps ``hang_secs`` before proceeding on a seeded per-call
+  coin flip (the stalled-RPC simulation): with a watchdog shorter than the
+  hang, the wrapper's :class:`MeasurementTimeout` path fires; without one,
+  the call is merely slow — both are realistic tunnel behaviors.
+* ``deterministic`` — fails by *schedule identity* (a hash of the schedule
+  id and the seed, not a per-call draw): the same ``rate`` fraction of
+  candidates always fails, exactly like a candidate that genuinely cannot
+  compile — the quarantine's target.
+* ``device_lost`` — raises :class:`~tenzing_tpu.fault.errors.DeviceLostError`
+  on a seeded per-call coin flip (the degradation drill).
+
+Injection draws are per-process: the harness is a single-host test/CI tool
+(multi-host chaos would need rank-agreed draws to be meaningful).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass
+from random import Random
+from typing import Dict, List, Optional
+
+from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult, schedule_id
+from tenzing_tpu.fault.errors import (
+    DeterministicScheduleError,
+    DeviceLostError,
+    TransientError,
+)
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs.tracer import get_tracer
+
+KINDS = ("transient", "hang", "deterministic", "device_lost")
+
+
+class InjectedTransientError(TransientError):
+    """A seeded injected tunnel flake."""
+
+
+class InjectedDeterministicError(DeterministicScheduleError):
+    """A seeded injected always-broken candidate."""
+
+
+@dataclass(frozen=True)
+class InjectSpec:
+    """One injection channel: ``kind`` at probability ``rate`` from ``seed``."""
+
+    kind: str
+    rate: float
+    seed: int
+
+
+def parse_inject_specs(text: str) -> List[InjectSpec]:
+    """Parse ``kind:rate:seed[,kind:rate:seed...]`` (the --inject-faults
+    grammar).  Errors are loud: a typo'd chaos spec silently injecting
+    nothing would make a green chaos run meaningless."""
+    specs: List[InjectSpec] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) != 3:
+            raise ValueError(
+                f"--inject-faults spec {part!r}: want kind:rate:seed")
+        kind, rate_s, seed_s = fields
+        if kind not in KINDS:
+            raise ValueError(
+                f"--inject-faults kind {kind!r}: want one of {KINDS}")
+        rate = float(rate_s)
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"--inject-faults rate {rate!r} not in [0, 1]")
+        specs.append(InjectSpec(kind=kind, rate=rate, seed=int(seed_s)))
+    if not specs:
+        raise ValueError("--inject-faults: empty spec")
+    return specs
+
+
+def _schedule_fails(sid: str, spec: InjectSpec) -> bool:
+    """Deterministic by schedule identity: hash(sid, seed) under rate."""
+    h = hashlib.sha256(f"{sid}:{spec.seed}".encode()).digest()
+    draw = int.from_bytes(h[:8], "big") / float(1 << 64)
+    return draw < spec.rate
+
+
+class FaultInjectingBenchmarker:
+    """Chaos wrapper (see module docstring).  ``injected`` counts injections
+    per kind; ``calls`` counts benchmark queries — the chaos tests assert on
+    both to prove the run actually exercised the fault paths."""
+
+    def __init__(self, inner, specs: List[InjectSpec],
+                 hang_secs: float = 60.0, sleep=time.sleep):
+        self.inner = inner
+        self.specs = list(specs)
+        self.hang_secs = hang_secs
+        self._sleep = sleep
+        self._rngs = {id(s): Random(s.seed) for s in self.specs}
+        self.calls = 0
+        self.injected: Dict[str, int] = {k: 0 for k in KINDS}
+        # forwarded so a wrapped EmpiricalBenchmarker still offers the batch
+        # protocol (injection applies per benchmark() query only: batches
+        # are the final verdict path, which chaos leaves untouched)
+        if hasattr(inner, "benchmark_batch_times"):
+            self.benchmark_batch_times = inner.benchmark_batch_times
+
+    def _record(self, kind: str, sid: str) -> None:
+        self.injected[kind] += 1
+        get_metrics().counter(f"fault.injected.{kind}").inc()
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event("fault.injected", kind=kind, schedule=sid)
+
+    def benchmark(self, order, opts: Optional[BenchOpts] = None) -> BenchResult:
+        self.calls += 1
+        sid = schedule_id(order)
+        for spec in self.specs:
+            if spec.kind == "deterministic":
+                if _schedule_fails(sid, spec):
+                    self._record("deterministic", sid)
+                    raise InjectedDeterministicError(
+                        f"injected deterministic failure (schedule {sid})")
+            elif self._rngs[id(spec)].random() < spec.rate:
+                if spec.kind == "transient":
+                    self._record("transient", sid)
+                    raise InjectedTransientError(
+                        f"injected transient failure (call {self.calls})")
+                if spec.kind == "hang":
+                    self._record("hang", sid)
+                    self._sleep(self.hang_secs)
+                elif spec.kind == "device_lost":
+                    self._record("device_lost", sid)
+                    raise DeviceLostError(
+                        f"injected device loss (call {self.calls})")
+        return self.inner.benchmark(order, opts)

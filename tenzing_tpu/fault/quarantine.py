@@ -1,0 +1,100 @@
+"""Persistent per-schedule quarantine for deterministic failures.
+
+A schedule that fails *deterministically* (compile error, liveness beyond
+device memory, shape violation — fault/errors.py) will fail the same way on
+every future attempt; re-measuring it burns a compile-and-crash cycle per
+encounter.  The quarantine records such candidates by their telemetry
+schedule id (``obs.tracer.short_digest`` of the serialized sequence — the
+same id every span/event carries, so quarantine entries correlate with the
+trace) and answers future queries instantly with
+:class:`~tenzing_tpu.fault.errors.QuarantinedScheduleError`.
+
+File format (docs/robustness.md): one JSON document
+``{"version": 1, "entries": {<schedule-id>: {"error": <exception type>,
+"error_class": ..., "message": ..., "n_ops": ...}}}`` rewritten atomically
+(tmp + rename) on every addition — additions are rare (one per broken
+candidate, ever) so the rewrite is cheap, and a crash mid-write leaves the
+previous complete file in place.  A missing or unreadable file is an empty
+quarantine (quarantine is an optimization, never a correctness gate), but
+an unreadable file is *reported* — silently dropping it would re-measure
+every quarantined candidate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+from tenzing_tpu.bench.benchmarker import schedule_id
+from tenzing_tpu.fault.checkpoint import atomic_dump_json
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs.tracer import get_tracer
+
+QUARANTINE_VERSION = 1
+
+
+class Quarantine:
+    """In-memory set of broken schedule ids, optionally file-backed.
+
+    ``path=None`` keeps the quarantine process-local (tests, callers that
+    manage persistence themselves); with a path, the constructor loads any
+    existing file and every :meth:`add` persists atomically."""
+
+    def __init__(self, path: Optional[str] = None, log=None):
+        self.path = path
+        self._log = log
+        self.entries: Dict[str, dict] = {}
+        if path is not None and os.path.exists(path):
+            self._load(path)
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if doc.get("version") != QUARANTINE_VERSION:
+                raise ValueError(
+                    f"quarantine version {doc.get('version')!r} != "
+                    f"{QUARANTINE_VERSION}")
+            self.entries = dict(doc["entries"])
+        except Exception as e:
+            self.entries = {}
+            if self._log is not None:
+                self._log(f"quarantine: ignoring unreadable {path}: "
+                          f"{type(e).__name__}: {e}")
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def key(self, order) -> str:
+        return schedule_id(order)
+
+    def check(self, order) -> Optional[dict]:
+        """The quarantine record for ``order``, or None when clean."""
+        return self.entries.get(self.key(order))
+
+    def add(self, order, exc: BaseException, error_class: str) -> str:
+        """Quarantine ``order`` (idempotent) and persist; returns the id."""
+        sid = self.key(order)
+        if sid not in self.entries:
+            self.entries[sid] = {
+                "error": type(exc).__name__,
+                "error_class": error_class,
+                "message": str(exc)[:500],
+                "n_ops": len(order) if hasattr(order, "__len__") else None,
+            }
+            get_metrics().counter("fault.quarantined").inc()
+            tr = get_tracer()
+            if tr.enabled:
+                tr.event("fault.quarantine", schedule=sid,
+                         error=type(exc).__name__, error_class=error_class)
+            self._persist()
+        return sid
+
+    def _persist(self) -> None:
+        if self.path is None:
+            return
+        atomic_dump_json(
+            self.path,
+            {"version": QUARANTINE_VERSION, "entries": self.entries},
+            prefix=".quarantine.")

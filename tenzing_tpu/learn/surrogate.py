@@ -138,6 +138,12 @@ class ScreeningBenchmarker:
         self.escalate_topk = max(1, int(escalate_topk))
         self.z = float(z)
         self.screen_only_opts = screen_only_opts
+        # model answers are deterministic and identical on every rank, so
+        # the screen is exactly as rank-coherent as the benchmarker it
+        # escalates to (fault/resilient.py's agreement protocol propagates
+        # through wrappers via this attribute — solvers check it before
+        # treating a multi-host benchmark failure as a reject)
+        self.rank_coherent = getattr(inner, "rank_coherent", False)
         self.hits = 0          # surrogate-answered queries
         self.escalations = 0   # queries forwarded to the empirical inner
         self._deltas: List[float] = []   # log(measured) - log(predicted)

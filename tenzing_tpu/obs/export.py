@@ -27,9 +27,22 @@ from typing import Any, Dict, List
 from tenzing_tpu.obs.tracer import Tracer
 
 
+def _snapshot(tracer: Tracer):
+    """One consistent read of the tracer for both sinks.  Non-blocking
+    (``block=False``): export runs from atexit AND from the signal trap
+    (bench.py ``write_telemetry``), where the interrupted thread may hold
+    the tracer lock — a blocking read there deadlocks the Ctrl-C path.
+    ``flush_open`` closes copies of all in-flight spans so an interrupted
+    run's bundle keeps them (marked ``flushed: true``) and every exported
+    ``parent`` id resolves to an exported span."""
+    spans, events, open_spans = tracer.snapshot(block=False, flush_open=True)
+    return spans + open_spans, events
+
+
 def _records(tracer: Tracer) -> List[Dict[str, Any]]:
-    recs = [s.to_json() for s in tracer.spans()]
-    recs += [e.to_json() for e in tracer.events()]
+    spans, events = _snapshot(tracer)
+    recs = [s.to_json() for s in spans]
+    recs += [e.to_json() for e in events]
     recs.sort(key=lambda r: r["ts_us"])
     return recs
 
@@ -67,7 +80,8 @@ def chrome_trace(tracer: Tracer) -> Dict[str, Any]:
     """The trace as a Chrome trace-event object (see module docstring)."""
     trace_events: List[Dict[str, Any]] = []
     pids = set()
-    for sp in tracer.spans():
+    spans, events = _snapshot(tracer)
+    for sp in spans:
         pids.add(sp.pid)
         trace_events.append({
             "name": sp.name,
@@ -79,7 +93,7 @@ def chrome_trace(tracer: Tracer) -> Dict[str, Any]:
             "tid": sp.tid,
             "args": sp.attrs,
         })
-    for ev in tracer.events():
+    for ev in events:
         pids.add(ev.pid)
         trace_events.append({
             "name": ev.name,

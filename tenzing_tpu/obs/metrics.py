@@ -106,25 +106,40 @@ class Histogram:
         with self._lock:
             return list(self._values)
 
-    def summary(self) -> Dict[str, float]:
-        """count/sum/min/max/mean + nearest-rank p50/p90/p99."""
-        with self._lock:
-            if self._count == 0:
-                return {"count": 0, "sum": 0.0}
+    def summary(self, block: bool = True) -> Dict[str, float]:
+        """count/sum/min/max/mean + nearest-rank p50/p90/p99.
+
+        ``block=False`` is the async-signal-safe read (bench.py's trap-path
+        ``write_telemetry``): if the instrument lock cannot be acquired —
+        the interrupted thread may hold it mid-``observe`` — the summary is
+        computed from a GIL-atomic copy of the fields instead of blocking
+        on a lock that will never be released."""
+        acquired = self._lock.acquire(blocking=block)
+        try:
+            count, total = self._count, self._sum
+            mn, mx = self._min, self._max
             xs = sorted(self._values)
-            out = {
-                "count": self._count,
-                "sum": self._sum,
-                "min": self._min,
-                "max": self._max,
-                "mean": self._sum / self._count,
-                "p50": percentile(xs, 50),
-                "p90": percentile(xs, 90),
-                "p99": percentile(xs, 99),
-            }
-            if len(xs) < self._count:
-                out["raw_retained"] = len(xs)
-            return out
+        finally:
+            if acquired:
+                self._lock.release()
+        if count == 0 or not xs:
+            # xs can be empty at count > 0 only on a torn non-blocking read
+            # (observe() bumps count before appending); report the exact
+            # aggregates without percentiles rather than crash in the trap
+            return {"count": count, "sum": total}
+        out = {
+            "count": count,
+            "sum": total,
+            "min": mn,
+            "max": mx,
+            "mean": total / count,
+            "p50": percentile(xs, 50),
+            "p90": percentile(xs, 90),
+            "p99": percentile(xs, 99),
+        }
+        if len(xs) < count:
+            out["raw_retained"] = len(xs)
+        return out
 
 
 class MetricsRegistry:
@@ -171,15 +186,25 @@ class MetricsRegistry:
         finally:
             self.histogram(name).observe(time.perf_counter() - t0)
 
-    def to_json(self) -> Dict[str, Any]:
-        with self._lock:
+    def to_json(self, block: bool = True) -> Dict[str, Any]:
+        """Serialize every instrument.  ``block=False`` is the
+        async-signal-safe variant (the trap path, bench.py
+        ``write_telemetry``): registry and per-histogram locks are taken
+        non-blocking with GIL-atomic dict/list copies as the fallback, so a
+        signal handler can archive metrics even while the interrupted
+        thread holds an instrument lock."""
+        acquired = self._lock.acquire(blocking=block)
+        try:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
+        finally:
+            if acquired:
+                self._lock.release()
         return {
             "counters": {n: c.value for n, c in sorted(counters.items())},
             "gauges": {n: g.value for n, g in sorted(gauges.items())},
-            "histograms": {n: h.summary()
+            "histograms": {n: h.summary(block=block)
                            for n, h in sorted(histograms.items())},
         }
 

@@ -138,6 +138,11 @@ class Tracer:
         self._listeners: List[Callable[[str, Any], None]] = []
         self._local = threading.local()
         self._tids: Dict[int, int] = {}
+        # live per-thread open-span stacks, keyed by thread ident: the
+        # export-time flush (ISSUE 3 satellite) reads OTHER threads' stacks
+        # to close in-flight spans, so the stacks must be reachable beyond
+        # the owning thread's threading.local view
+        self._open_stacks: Dict[int, List[Span]] = {}
         self._next_span_id = 0
         # one perf_counter anchor -> monotonic unix-us timestamps
         self._t0_unix = time.time()
@@ -159,6 +164,8 @@ class Tracer:
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
+            with self._lock:
+                self._open_stacks[threading.get_ident()] = stack
         return stack
 
     def set_rank(self, rank: int) -> None:
@@ -221,6 +228,47 @@ class Tracer:
     def events(self) -> List[Event]:
         with self._lock:
             return list(self._events)
+
+    def snapshot(self, block: bool = True, flush_open: bool = True):
+        """(finished spans, events, flushed open spans) — THE export-time
+        read (obs/export.py).
+
+        ``block=False`` makes the read **async-signal-safe**: the lock is
+        taken with ``blocking=False`` and, when it cannot be acquired (the
+        interrupted thread may hold it — the Ctrl-C + ``--trace-out``
+        deadlock this replaces), the lists are copied without it.  A bare
+        ``list(x)`` of a list is atomic under the GIL, so the fallback
+        yields a consistent prefix rather than a crash or a hang.
+
+        ``flush_open`` closes a *copy* of every in-flight span (all
+        threads) with duration up-to-now and a ``flushed: true`` attribute:
+        an interrupted run's bundle keeps its open ``mcts.iter`` /
+        ``bench.benchmark`` spans, and no exported record references a
+        parent id that never exports (the dangling-parent gap)."""
+        acquired = self._lock.acquire(blocking=block)
+        try:
+            # stacks first: a span closing concurrently then shows up in
+            # both copies (filtered by span id below), never in neither
+            stacks = [list(s) for s in list(self._open_stacks.values())]
+            spans = list(self._spans)
+            events = list(self._events)
+        finally:
+            if acquired:
+                self._lock.release()
+        open_spans: List[Span] = []
+        if flush_open:
+            now = self._now_us()
+            done_ids = {s.span_id for s in spans}
+            for stack in stacks:
+                for sp in stack:
+                    if sp.span_id in done_ids:
+                        continue
+                    cp = Span(sp.name, sp.ts_us, sp.pid, sp.tid, sp.span_id,
+                              sp.parent_id, dict(sp.attrs))
+                    cp.dur_us = max(0.0, now - sp.ts_us)
+                    cp.attrs["flushed"] = True
+                    open_spans.append(cp)
+        return spans, events, open_spans
 
     def clear(self) -> None:
         with self._lock:

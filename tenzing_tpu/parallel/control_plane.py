@@ -45,6 +45,18 @@ class ControlPlane:
         """Max over hosts (reference MPI_Allreduce MAX, benchmarker.cpp:101,145)."""
         return x
 
+    def agree_fault(self, code: int) -> int:
+        """Rank-coherent failure agreement — THE primitive
+        ``fault.resilient.ResilientBenchmarker`` brackets every measurement
+        attempt with: each rank contributes its local fault code
+        (``fault.errors.FaultClass.CODES``, 0 = healthy, ordered by
+        severity) and every rank receives the worst code seen anywhere, so
+        a failure on one rank becomes a failure on all ranks at the same
+        attempt boundary instead of a deadlock in the next collective.
+        Expressed over :meth:`allreduce_max` so both realizations (identity
+        on one host, ``process_allgather`` under jax.distributed) agree."""
+        return int(self.allreduce_max(float(code)))
+
 
 class JaxControlPlane(ControlPlane):
     """Multi-host control plane over jax.distributed (requires

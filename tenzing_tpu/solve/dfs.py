@@ -58,6 +58,11 @@ class DfsOpts:
     batch_seed: int = 0
     prescreen: Optional[object] = None  # learn SurrogateBenchmarker
     prescreen_keep: int = 0
+    # fault.checkpoint.SearchCheckpoint: rank 0 snapshots the frontier
+    # cursor (next un-benchmarked terminal index) per measurement; resume
+    # re-enumerates (deterministic) and the journal-restored cache answers
+    # every already-measured terminal instantly (docs/robustness.md)
+    checkpoint: Optional[object] = None
 
     def to_json(self) -> dict:
         """Provenance stamp of the options (reference dfs.cpp:11-14)."""
@@ -289,6 +294,9 @@ def explore(
             result.dump_csv(opts.dump_csv_path)
         else:
             sys.stdout.write(result.dump_csv())
+        if opts.checkpoint is not None and cp.rank() == 0:
+            opts.checkpoint.save_state(
+                dfs={"n_sims": len(result.sims), "interrupted": True})
 
     trap.register_handler(dump_partial)
     try:
@@ -360,7 +368,16 @@ def explore(
                 # between clear() and the copy would otherwise dump an empty CSV
                 # despite every measurement having completed (trap.py contract)
                 batch_partial.clear()
+                if opts.checkpoint is not None and cp.rank() == 0:
+                    opts.checkpoint.save_state(
+                        dfs={"batch_done": True, "n_sims": len(result.sims)})
             else:
+                # reject policy mirrors MCTS: a terminal that fails to
+                # compile/run is a dead end, not a search crash — safe
+                # single-host, and multi-host when the benchmarker's
+                # rank-coherent agreement made every rank fail together
+                reject_ok = cp.size() == 1 or getattr(
+                    benchmarker, "rank_coherent", False)
                 for i in range(n):
                     with tr.span("dfs.iter", i=i) as sp:
                         if cp.rank() == 0:
@@ -375,11 +392,45 @@ def explore(
                         else:
                             order = sequence_from_json(payload, graph)
                         with counters.phase("BENCHMARK"):
-                            res = benchmarker.benchmark(order, opts.bench_opts)
+                            try:
+                                res = benchmarker.benchmark(
+                                    order, opts.bench_opts)
+                            except Exception as e:
+                                from tenzing_tpu.fault.errors import (
+                                    DeviceLostError,
+                                )
+
+                                # device loss is fatal, not a candidate
+                                # verdict (fault/resilient.py escalation)
+                                if not reject_ok or isinstance(
+                                        e, DeviceLostError):
+                                    raise
+                                from tenzing_tpu.bench.benchmarker import (
+                                    candidate_failed,
+                                )
+
+                                candidate_failed("dfs.benchmark", order, e)
+                                reporter.warn(
+                                    "tenzing-tpu: dfs terminal rejected "
+                                    f"(failed to compile/run: "
+                                    f"{type(e).__name__}: {str(e)[:200]})",
+                                    i=i,
+                                )
+                                sp.set("rejected", True)
+                                continue
                         if tr.enabled:
                             sp.set("schedule", schedule_id(order))
                             sp.set("pct50", res.pct50)
                         result.sims.append(SimResult(order=order, result=res))
+                    # throttled: the cursor is consistency metadata (resume
+                    # reconstructs from the journal, which has its own
+                    # per-measurement fsync) — an atomic rewrite per
+                    # terminal would double the sync I/O of the hot loop
+                    if opts.checkpoint is not None and cp.rank() == 0 and (
+                            i % 25 == 0 or i == n - 1):
+                        opts.checkpoint.save_state(
+                            dfs={"i": i, "n": n,
+                                 "n_sims": len(result.sims)})
             if opts.dump_csv_path and cp.rank() == 0:
                 result.dump_csv(opts.dump_csv_path)
             return result

@@ -190,6 +190,12 @@ class LocalOpts:
     paired: bool = False
     prescreen: Optional[object] = None  # learn SurrogateBenchmarker
     prescreen_z: float = 2.0
+    # fault.checkpoint.SearchCheckpoint: snapshots the climb cursor (budget
+    # spent, accepted moves) per measured neighbor; resume re-executes the
+    # seeded climb against the journal-restored cache (cache hits are free
+    # — the budget is re-spent only on schedules never measured before), so
+    # the accepted chain reconstructs deterministically
+    checkpoint: Optional[object] = None
 
 
 @dataclass
@@ -231,6 +237,10 @@ def hill_climb(
         except Exception as e:
             import sys
 
+            from tenzing_tpu.fault.errors import DeviceLostError
+
+            if isinstance(e, DeviceLostError):
+                raise  # fatal escalation, never a neighbor verdict
             candidate_failed("local.measure", seq_, e)
             sys.stderr.write(
                 "hill-climb: schedule rejected (failed to compile/run: "
@@ -263,6 +273,10 @@ def hill_climb(
         except Exception as e:  # compile/runtime failure of the candidate
             import sys
 
+            from tenzing_tpu.fault.errors import DeviceLostError
+
+            if isinstance(e, DeviceLostError):
+                raise  # fatal escalation, never a neighbor verdict
             candidate_failed("local.paired", cand_seq, e)
             sys.stderr.write(
                 "hill-climb: neighbor rejected (failed to compile/run: "
@@ -283,6 +297,15 @@ def hill_climb(
         )
     seen = {canonical_key(seq)}
     spent = 1 if charge else 0
+    accepted = 0
+
+    def save_cursor():
+        if opts.checkpoint is not None:
+            opts.checkpoint.save_state(
+                climb={"spent": spent, "accepted": accepted,
+                       "n_sims": len(result.sims)})
+
+    save_cursor()
 
     def sweep_order(decs):
         """Shuffled positions, structural decisions (implementation choices,
@@ -342,10 +365,16 @@ def hill_climb(
                 if accept:  # first improvement: move
                     cur, seq, decisions = res, cand_seq, cand_dec
                     improved = True
+                    accepted += 1
+                    save_cursor()  # accepted moves only: the cursor is
+                    # consistency metadata (resume replays the journal), so
+                    # a per-neighbor atomic rewrite would just double the
+                    # measurement loop's sync I/O
                     break
                 if spent >= opts.budget:
                     break
             if improved or spent >= opts.budget:
                 break
+    save_cursor()  # final spend/accept tallies
     result.final = SimResult(order=seq, result=cur)
     return result

@@ -69,6 +69,12 @@ class MctsOpts:
     # remove_redundant_syncs) to already-timed schedules reuse the recorded
     # result instead of recompiling and re-running (VERDICT r1 weak #5)
     cache_benchmarks: bool = True
+    # fault.checkpoint.SearchCheckpoint: when set, rank 0 snapshots the
+    # solver cursor (iteration, sims, tree size) after every iteration and
+    # the trap handler writes a final snapshot — resume re-executes the
+    # deterministic search against the journal-restored benchmark cache,
+    # reconstructing the tree exactly (docs/robustness.md)
+    checkpoint: Optional[object] = None
 
     def to_json(self) -> dict:
         return {
@@ -192,12 +198,24 @@ def explore(
         # cache locally on every host: the broadcast order is identical on all
         # hosts, so hits/misses agree rank-to-rank (no divergent collectives)
         benchmarker = CachingBenchmarker(benchmarker)
+    # a rank-coherent benchmarker (fault.resilient.ResilientBenchmarker, or
+    # any wrapper forwarding its flag) guarantees every rank sees the same
+    # failure at the same point, so the reject path is safe under a
+    # multi-host control plane too — without it, a rank-local failure must
+    # crash rather than desync the per-measurement barrier protocol
+    reject_ok = cp.size() == 1 or getattr(benchmarker, "rank_coherent", False)
 
     def dump_partial():  # reference mcts.hpp:174-179
         if opts.dump_csv_path:
             result.dump_csv(opts.dump_csv_path)
         else:
             sys.stdout.write(result.dump_csv())
+        if opts.checkpoint is not None and cp.rank() == 0:
+            # the SIGINT final snapshot (ISSUE 3): the journal already holds
+            # every completed measurement; this stamps the cursor so resume
+            # tooling can report how far the interrupted run got
+            opts.checkpoint.save_state(
+                mcts={"n_sims": len(result.sims), "interrupted": True})
 
     trap.register_handler(dump_partial)
     # manual enter/exit (not `with`): the finally below must set the
@@ -287,12 +305,18 @@ def explore(
                             # a rollout whose schedule cannot compile/run on
                             # the hardware (e.g. liveness exceeding device
                             # memory) is a legitimate dead end, not a search
-                            # crash.  Only safe single-host: under a
-                            # multi-host control plane a rank-local failure
-                            # would desync the per-measurement barrier/
-                            # allreduce protocol, so there the error must
-                            # propagate (a crash beats a collective deadlock).
-                            if cp.size() > 1:
+                            # crash.  Safe single-host, and multi-host when
+                            # the benchmarker is rank-coherent (its agreement
+                            # protocol made every rank fail together);
+                            # otherwise a rank-local failure would desync the
+                            # per-measurement barrier/allreduce protocol, so
+                            # there the error must propagate (a crash beats a
+                            # collective deadlock).  Device loss is never a
+                            # per-candidate verdict: without a degradation
+                            # fallback it must escalate out of the search.
+                            from tenzing_tpu.fault.errors import DeviceLostError
+
+                            if not reject_ok or isinstance(e, DeviceLostError):
                                 raise
                             candidate_failed("mcts.rollout", order, e)
                             reporter.warn(
@@ -333,6 +357,15 @@ def explore(
                         path = f"{opts.dump_tree_prefix}_{it:06d}.dot"
                         with open(path, "w") as f:
                             f.write(root.dump_graphviz())
+                    if opts.checkpoint is not None:
+                        # cursor snapshot per completed iteration: the tree
+                        # itself reconstructs on resume by re-executing the
+                        # seeded search against the journal-restored cache
+                        # (every answer identical, zero device time), so the
+                        # checkpoint only needs the generative cursor
+                        opts.checkpoint.save_state(
+                            mcts={"it": it, "n_sims": len(result.sims),
+                                  "tree_size": root.size()})
         # multi-fidelity confirm: the top-k distinct screened schedules are
         # re-measured at the full bench_opts floor so the solver's official
         # output carries final-fidelity numbers (the CachingBenchmarker key
@@ -370,7 +403,9 @@ def explore(
                     try:
                         res = benchmarker.benchmark(order, opts.bench_opts)
                     except Exception as e:
-                        if cp.size() > 1:
+                        from tenzing_tpu.fault.errors import DeviceLostError
+
+                        if not reject_ok or isinstance(e, DeviceLostError):
                             raise
                         candidate_failed("mcts.confirm", order, e)
                         reporter.warn(

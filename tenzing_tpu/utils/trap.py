@@ -2,28 +2,56 @@
 
 Parity target: reference ``src/trap.cpp:9-35``: solvers install a SIGINT/SIGABRT
 handler so a wall-clock-limited job (e.g. SLURM ``--signal=SIGABRT@10``) still
-dumps the schedules explored so far before dying."""
+dumps the schedules explored so far before dying.
+
+Callbacks registered here run *inside a signal handler*: they must not block
+on locks the interrupted thread may hold (the obs exporters and
+``MetricsRegistry.to_json`` offer ``block=False`` reads for exactly this —
+docs/robustness.md), and one callback raising must not silence the others
+(:func:`run_callbacks` isolates each; covered by tests/test_trap.py).
+"""
 
 from __future__ import annotations
 
 import signal
 import sys
-from typing import Callable, List, Optional
+from typing import Callable, List
 
 _callbacks: List[Callable[[], None]] = []
 _prev_handlers: dict = {}
 
 
-def _handler(signum, frame):  # pragma: no cover - signal path
+def run_callbacks() -> int:
+    """Run every registered dump callback, isolating failures: a raising
+    callback is reported on stderr and the rest still run.  Returns the
+    number of callbacks that failed.  Split out of the handler so the
+    callback semantics are testable without delivering a real signal."""
+    failed = 0
     for cb in list(_callbacks):
         try:
             cb()
         except Exception as e:
+            failed += 1
             # bare write, not the ProgressReporter: a signal handler must
             # not touch shared telemetry state mid-crash
             sys.stderr.write(f"trap: dump callback failed: {e}\n")
+    return failed
+
+
+def _handler(signum, frame):  # pragma: no cover - signal path
+    run_callbacks()
     signal.signal(signum, signal.SIG_DFL)
     signal.raise_signal(signum)
+
+
+def installed() -> bool:
+    """True while the trap handler owns SIGINT/SIGABRT."""
+    return bool(_prev_handlers)
+
+
+def callbacks() -> List[Callable[[], None]]:
+    """Snapshot of the registered callbacks (registration order)."""
+    return list(_callbacks)
 
 
 def register_handler(dump: Callable[[], None]) -> None:

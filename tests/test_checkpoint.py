@@ -1,0 +1,214 @@
+"""fault/checkpoint.py: atomic digest-checked snapshots, the crash-safe
+measurement journal, and cache restore (the --resume substrate)."""
+
+import json
+import os
+
+import pytest
+
+from tenzing_tpu.bench.benchmarker import (
+    BenchOpts,
+    BenchResult,
+    CachingBenchmarker,
+)
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.fault import (
+    CheckpointError,
+    JournalingBenchmarker,
+    SearchCheckpoint,
+    atomic_write_json,
+    read_checked_json,
+)
+from tenzing_tpu.fault.checkpoint import (
+    PROVENANCE_DEGRADED,
+    PROVENANCE_MEASURED,
+)
+from tenzing_tpu.models.spmv import SpMVCompound
+from tenzing_tpu.solve.dfs import enumerate_schedules
+
+
+def _graph():
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    return g
+
+
+@pytest.fixture(scope="module")
+def seqs():
+    """A few distinct real schedules to journal (device-free)."""
+    states = enumerate_schedules(_graph(), Platform.make_n_lanes(2),
+                                 max_seqs=6)
+    assert len(states) >= 3
+    return [st.sequence for st in states]
+
+
+def _res(t):
+    return BenchResult.from_times([t, t * 1.01, t * 0.99])
+
+
+class CountingBench:
+    def __init__(self):
+        self.calls = 0
+
+    def benchmark(self, order, opts=None):
+        self.calls += 1
+        return _res(5.0)
+
+
+# -- atomic envelope --------------------------------------------------------
+
+def test_atomic_write_round_trips(tmp_path):
+    path = str(tmp_path / "state.json")
+    atomic_write_json(path, {"a": 1, "nested": {"b": [1, 2]}})
+    assert read_checked_json(path) == {"a": 1, "nested": {"b": [1, 2]}}
+    assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+def test_corrupt_digest_raises(tmp_path):
+    path = str(tmp_path / "state.json")
+    atomic_write_json(path, {"a": 1})
+    doc = json.load(open(path))
+    doc["payload"]["a"] = 2  # tamper without updating the digest
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(CheckpointError, match="digest"):
+        read_checked_json(path)
+
+
+def test_version_mismatch_raises(tmp_path):
+    path = str(tmp_path / "state.json")
+    atomic_write_json(path, {"a": 1})
+    doc = json.load(open(path))
+    doc["version"] = 999
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(CheckpointError, match="version"):
+        read_checked_json(path)
+
+
+def test_truncated_file_raises(tmp_path):
+    path = tmp_path / "state.json"
+    atomic_write_json(str(path), {"a": 1})
+    path.write_text(path.read_text()[:-10])  # torn write simulation
+    with pytest.raises(CheckpointError):
+        read_checked_json(str(path))
+
+
+# -- state snapshots --------------------------------------------------------
+
+def test_save_state_merge_semantics(tmp_path):
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    ck.save_state(config={"workload": "spmv"})
+    ck.save_state(mcts={"it": 3})
+    ck.save_state(mcts={"it": 4}, done=True)
+    got = SearchCheckpoint(str(tmp_path / "ckpt")).load_state()
+    assert got == {"config": {"workload": "spmv"}, "mcts": {"it": 4},
+                   "done": True}
+
+
+def test_load_state_absent_is_none(tmp_path):
+    assert SearchCheckpoint(str(tmp_path / "ckpt")).load_state() is None
+
+
+# -- measurement journal ----------------------------------------------------
+
+def test_journal_round_trips_sequences_and_results(tmp_path, seqs):
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    opts = BenchOpts(n_iters=7, max_retries=2, target_secs=0.01)
+    ck.record(seqs[0], opts, _res(1.0))
+    ck.record(seqs[1], None, _res(2.0), provenance=PROVENANCE_DEGRADED)
+    got = ck.load_measurements(_graph())
+    assert len(got) == 2
+    (s0, o0, r0, p0), (s1, o1, r1, p1) = got
+    assert o0 == opts and o1 is None
+    assert r0.pct50 == 1.0 and r1.pct50 == 2.0  # exact float round-trip
+    assert r0.times is not None
+    assert p0 == PROVENANCE_MEASURED and p1 == PROVENANCE_DEGRADED
+    from tenzing_tpu.core.sequence import canonical_key
+
+    assert canonical_key(s0) == canonical_key(seqs[0])
+
+
+def test_journal_skips_torn_tail_line(tmp_path, seqs):
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    ck.record(seqs[0], None, _res(1.0))
+    ck.close()
+    with open(ck.journal_path, "a") as f:
+        f.write('{"opts": null, "prov": "measured", "resu')  # killed mid-write
+    notes = []
+    got = ck.load_measurements(_graph(), log=notes.append)
+    assert len(got) == 1
+    assert notes and "skipped" in notes[0]
+
+
+def test_restore_into_answers_without_device(tmp_path, seqs):
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    opts = BenchOpts(n_iters=3, max_retries=1, target_secs=0.001)
+    ck.record(seqs[0], opts, _res(1.5))
+    ck.record(seqs[1], opts, _res(2.5))
+    inner = CountingBench()
+    cache = CachingBenchmarker(inner)
+    n = ck.restore_into(cache, _graph())
+    assert n == 2
+    # restored schedules never touch the device, results are bit-identical
+    assert cache.benchmark(seqs[0], opts).pct50 == 1.5
+    assert cache.benchmark(seqs[1], opts).pct50 == 2.5
+    assert inner.calls == 0
+    # a different fidelity (opts) is a different measurement: device
+    other = BenchOpts(n_iters=99)
+    cache.benchmark(seqs[0], other)
+    assert inner.calls == 1
+    # an unseen schedule: device
+    cache.benchmark(seqs[2], opts)
+    assert inner.calls == 2
+
+
+def test_restore_skips_non_measured_provenance(tmp_path, seqs):
+    """Degraded/model rows journal for the record but must re-measure on a
+    healthy resumed device — they are predictions, not measurements."""
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    ck.record(seqs[0], None, _res(1.0), provenance=PROVENANCE_DEGRADED)
+    ck.record(seqs[1], None, _res(2.0), provenance="model")
+    ck.record(seqs[2], None, _res(3.0))
+    cache = CachingBenchmarker(CountingBench())
+    assert ck.restore_into(cache, _graph()) == 1
+
+
+def test_later_journal_lines_supersede(tmp_path, seqs):
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    ck.record(seqs[0], None, _res(1.0))
+    ck.record(seqs[0], None, _res(9.0))  # re-measured later in the run
+    cache = CachingBenchmarker(CountingBench())
+    ck.restore_into(cache, _graph())
+    assert cache.benchmark(seqs[0], None).pct50 == 9.0
+
+
+def test_journaling_benchmarker_records_each_measurement(tmp_path, seqs):
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    inner = CountingBench()
+    jb = JournalingBenchmarker(inner, ck)
+    opts = BenchOpts()
+    jb.benchmark(seqs[0], opts)
+    jb.benchmark(seqs[1], opts)
+    assert inner.calls == 2
+    got = ck.load_measurements(_graph())
+    assert len(got) == 2
+    assert all(p == PROVENANCE_MEASURED for *_, p in got)
+
+
+def test_journaling_benchmarker_tags_degraded(tmp_path, seqs):
+    class DegradedInner:
+        degraded = True
+
+        def was_degraded(self, order):
+            return True
+
+        def benchmark(self, order, opts=None):
+            return _res(4.0)
+
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    JournalingBenchmarker(DegradedInner(), ck).benchmark(seqs[0], None)
+    (_, _, _, prov), = ck.load_measurements(_graph())
+    assert prov == PROVENANCE_DEGRADED

@@ -412,3 +412,130 @@ def test_bench_result_equality_ignores_provenance():
     b = BenchResult(pct01=1.0, pct10=1.0, pct50=1.0, pct90=1.0, pct99=1.0,
                     stddev=0.0)
     assert a == b
+
+
+# -- interrupt hardening (ISSUE 3 satellites) --------------------------------
+
+def test_export_flushes_open_spans_and_resolves_parents(tracer):
+    """An export taken mid-run (the interrupted-search case) must keep the
+    in-flight spans — closed as copies with ``flushed: true`` — and emit no
+    record whose parent id is missing from the bundle."""
+    with tracer.span("mcts.explore"):
+        with tracer.span("mcts.iter", it=3):
+            with tracer.span("bench.benchmark"):
+                text = to_jsonl(tracer)
+    recs = [json.loads(line) for line in text.splitlines()]
+    spans = {r["id"]: r for r in recs if r["kind"] == "span"}
+    names = {r["name"] for r in spans.values()}
+    assert {"mcts.explore", "mcts.iter", "bench.benchmark"} <= names
+    for r in spans.values():
+        assert r["attrs"].get("flushed") is True
+        if r["parent"] is not None:
+            assert r["parent"] in spans  # no dangling parent ids
+    # flushed durations are up-to-now, monotone down the stack
+    by_name = {r["name"]: r for r in spans.values()}
+    assert by_name["mcts.explore"]["dur_us"] >= \
+        by_name["mcts.iter"]["dur_us"] >= \
+        by_name["bench.benchmark"]["dur_us"] >= 0
+
+
+def test_flushed_span_not_duplicated_once_closed(tracer):
+    with tracer.span("outer"):
+        mid = to_jsonl(tracer)
+    final = to_jsonl(tracer)
+    assert sum(1 for line in mid.splitlines()
+               if json.loads(line)["name"] == "outer") == 1
+    outer = [json.loads(line) for line in final.splitlines()
+             if json.loads(line)["name"] == "outer"]
+    assert len(outer) == 1  # the finished record replaces the flushed copy
+    assert "flushed" not in outer[0]["attrs"]
+
+
+def test_export_flushes_other_threads_open_spans(tracer):
+    """An interrupt on the main thread must still see in-flight spans of
+    worker threads (the DFS batch / watchdog threads)."""
+    import threading
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        with tracer.span("bench.batch"):
+            started.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    try:
+        assert started.wait(5.0)
+        recs = [json.loads(line) for line in to_jsonl(tracer).splitlines()]
+        flushed = [r for r in recs if r["name"] == "bench.batch"]
+        assert len(flushed) == 1 and flushed[0]["attrs"]["flushed"] is True
+    finally:
+        release.set()
+        t.join(5.0)
+
+
+def test_export_does_not_block_on_held_tracer_lock(tracer):
+    """The trap-path guarantee: exporting while another thread holds the
+    tracer lock (the interrupted thread, in the real deadlock) completes
+    via the lock-free fallback instead of hanging."""
+    import threading
+
+    with tracer.span("held"):
+        pass
+    tracer._lock.acquire()
+    try:
+        out = {}
+
+        def export():
+            out["jsonl"] = to_jsonl(tracer)
+            out["chrome"] = chrome_trace(tracer)
+
+        t = threading.Thread(target=export, daemon=True)
+        t.start()
+        t.join(5.0)
+        assert not t.is_alive(), "export deadlocked on the tracer lock"
+    finally:
+        tracer._lock.release()
+    assert any(json.loads(line)["name"] == "held"
+               for line in out["jsonl"].splitlines())
+    assert any(e.get("name") == "held"
+               for e in out["chrome"]["traceEvents"])
+
+
+def test_metrics_to_json_does_not_block_on_held_locks(registry):
+    import threading
+
+    registry.counter("c").inc(3)
+    h = registry.histogram("h")
+    h.observe(1.0)
+    h.observe(2.0)
+    # both the registry lock and an instrument lock are held by "the
+    # interrupted thread"
+    registry._lock.acquire()
+    h._lock.acquire()
+    try:
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(doc=registry.to_json(block=False)),
+            daemon=True)
+        t.start()
+        t.join(5.0)
+        assert not t.is_alive(), "to_json deadlocked on instrument locks"
+    finally:
+        h._lock.release()
+        registry._lock.release()
+    assert out["doc"]["counters"]["c"] == 3
+    assert out["doc"]["histograms"]["h"]["count"] == 2
+
+
+def test_chrome_trace_includes_flushed_spans_with_valid_schema(tracer,
+                                                               tmp_path):
+    with tracer.span("open.one"):
+        doc = chrome_trace(tracer)
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["name"] == "open.one" and e["args"].get("flushed")
+               for e in xs)
+    for e in xs:
+        assert e["dur"] >= 0 and isinstance(e["ts"], float)

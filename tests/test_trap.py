@@ -1,0 +1,114 @@
+"""utils/trap.py lifecycle (ISSUE 3 satellite): register/unregister handler
+installation, multi-callback dispatch, and failure isolation — a raising dump
+callback must not silence the others (the reference's partial-dump guarantee,
+src/trap.cpp:9-35)."""
+
+import signal
+
+import pytest
+
+from tenzing_tpu.utils import trap
+
+
+@pytest.fixture(autouse=True)
+def _clean_trap_state():
+    """Tests must never leak a trap installation into the rest of the suite
+    (a stray handler would intercept pytest's own Ctrl-C)."""
+    assert not trap.installed()
+    yield
+    for cb in trap.callbacks():
+        trap.unregister_handler(cb)
+    assert not trap.installed()
+
+
+def test_register_installs_and_unregister_restores_handlers():
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_abrt = signal.getsignal(signal.SIGABRT)
+
+    def dump():
+        pass
+
+    trap.register_handler(dump)
+    assert trap.installed()
+    assert signal.getsignal(signal.SIGINT) is trap._handler
+    assert signal.getsignal(signal.SIGABRT) is trap._handler
+    trap.unregister_handler(dump)
+    assert not trap.installed()
+    assert signal.getsignal(signal.SIGINT) is prev_int
+    assert signal.getsignal(signal.SIGABRT) is prev_abrt
+
+
+def test_handler_survives_until_last_unregister():
+    """Nested solver registrations (MCTS inside bench.py's telemetry trap)
+    keep ONE installed handler until the last callback unregisters."""
+    a, b = (lambda: None), (lambda: None)
+    trap.register_handler(a)
+    installed_handler = signal.getsignal(signal.SIGINT)
+    trap.register_handler(b)
+    # second registration does not re-install (the previous-handler map
+    # must keep the ORIGINAL pre-trap handlers, not the trap itself)
+    assert signal.getsignal(signal.SIGINT) is installed_handler
+    trap.unregister_handler(a)
+    assert trap.installed()
+    assert signal.getsignal(signal.SIGINT) is installed_handler
+    trap.unregister_handler(b)
+    assert not trap.installed()
+
+
+def test_multiple_callbacks_run_in_registration_order():
+    order = []
+    a = lambda: order.append("a")  # noqa: E731
+    b = lambda: order.append("b")  # noqa: E731
+    trap.register_handler(a)
+    trap.register_handler(b)
+    failed = trap.run_callbacks()
+    assert failed == 0
+    assert order == ["a", "b"]
+
+
+def test_raising_callback_does_not_prevent_the_others(capsys):
+    ran = []
+
+    def bad():
+        raise RuntimeError("dump exploded")
+
+    def good():
+        ran.append(True)
+
+    trap.register_handler(bad)
+    trap.register_handler(good)
+    failed = trap.run_callbacks()
+    assert failed == 1
+    assert ran == [True]  # the good callback still ran
+    assert "dump exploded" in capsys.readouterr().err
+
+
+def test_unregister_unknown_callback_is_noop():
+    known = lambda: None  # noqa: E731
+    trap.register_handler(known)
+    trap.unregister_handler(lambda: None)  # never registered
+    assert trap.installed()
+    assert trap.callbacks() == [known]
+    trap.unregister_handler(known)
+
+
+def test_callbacks_registered_during_dispatch_do_not_run_this_round():
+    """run_callbacks iterates a snapshot: a callback registering another
+    callback mid-dispatch must not grow the current round (the signal path
+    must terminate)."""
+    ran = []
+
+    def second():
+        ran.append("second")
+
+    def first():
+        ran.append("first")
+        trap.register_handler(second)
+
+    trap.register_handler(first)
+    trap.run_callbacks()
+    assert ran == ["first"]
+    # the newly-registered callback runs on the NEXT dispatch
+    ran.clear()
+    trap.run_callbacks()
+    assert ran == ["first", "second"]
