@@ -297,12 +297,22 @@ def main() -> int:
                     metavar="KIND:RATE:SEED[,...]",
                     help="seeded chaos (fault/inject.py): deterministically "
                          "inject transient errors / hangs / deterministic "
-                         "failures / device loss into every measurement "
-                         "(kinds: transient, hang, deterministic, "
-                         "device_lost)")
+                         "failures / device loss / schedule corruption "
+                         "into every measurement (kinds: transient, hang, "
+                         "deterministic, device_lost, corrupt)")
     ap.add_argument("--inject-hang-secs", type=float, default=60.0,
                     help="how long an injected hang stalls (pair with "
                          "--measure-timeout to exercise the watchdog)")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="disable the independent schedule-soundness "
+                         "verifier (docs/robustness.md): the guard in the "
+                         "measurement stack, the solver accept points, and "
+                         "the final winner-vs-naive result-integrity gate")
+    ap.add_argument("--verify-tol", type=float, default=0.02,
+                    metavar="RTOL",
+                    help="relative tolerance of the result-integrity "
+                         "gate's winner-vs-naive output comparison (loose "
+                         "enough for bf16-staging menu choices)")
     args = ap.parse_args()
     if args.resume and not args.checkpoint:
         # silently ignoring --resume would re-measure a multi-hour search
@@ -479,8 +489,14 @@ def main() -> int:
     # fault-tolerance stack (docs/robustness.md), inside-out:
     #   EmpiricalBenchmarker            device measurement
     #   [FaultInjectingBenchmarker]     --inject-faults seeded chaos
-    #   ResilientBenchmarker            watchdog / classified retry /
-    #                                   quarantine / degradation
+    #                                   (measurement-fault kinds)
+    #   ResilientBenchmarker            soundness gate / watchdog /
+    #                                   classified retry / quarantine /
+    #                                   degradation
+    #   [FaultInjectingBenchmarker]     --inject-faults corrupt: schedule
+    #                                   corruption — ABOVE the resilient
+    #                                   layer so its verifier gate sees
+    #                                   (and quarantines) the mutation
     #   [JournalingBenchmarker]         --checkpoint measurement journal
     #   CachingBenchmarker              equivalence-keyed cache (also the
     #                                   --resume restore target)
@@ -490,17 +506,30 @@ def main() -> int:
         ResilientBenchmarker,
         SearchCheckpoint,
     )
+    from tenzing_tpu.verify import ScheduleVerifier
 
+    verifier = None if args.no_verify else ScheduleVerifier(g)
+    inner_specs, corrupt_specs = [], []
+    if args.inject_faults:
+        from tenzing_tpu.fault import parse_inject_specs
+
+        specs = parse_inject_specs(args.inject_faults)
+        inner_specs = [s for s in specs if s.kind != "corrupt"]
+        corrupt_specs = [s for s in specs if s.kind == "corrupt"]
+        if corrupt_specs and verifier is None:
+            # corruption without the verifier would MEASURE broken
+            # schedules — a chaos run that poisons its own archive
+            ap.error("--inject-faults corrupt: requires the soundness "
+                     "verifier (drop --no-verify)")
+        sys.stderr.write(f"chaos: injecting {args.inject_faults}\n")
     measured_stack = emp
     injector = None
-    if args.inject_faults:
-        from tenzing_tpu.fault import FaultInjectingBenchmarker, parse_inject_specs
+    if inner_specs:
+        from tenzing_tpu.fault import FaultInjectingBenchmarker
 
         injector = FaultInjectingBenchmarker(
-            emp, parse_inject_specs(args.inject_faults),
-            hang_secs=args.inject_hang_secs)
+            emp, inner_specs, hang_secs=args.inject_hang_secs)
         measured_stack = injector
-        sys.stderr.write(f"chaos: injecting {args.inject_faults}\n")
     ckpt = SearchCheckpoint(args.checkpoint) if args.checkpoint else None
     quar = Quarantine(ckpt.quarantine_path if ckpt else None,
                       log=lambda m: sys.stderr.write(m + "\n"))
@@ -510,9 +539,18 @@ def main() -> int:
             "runs will not be re-measured\n")
     resilient = ResilientBenchmarker(
         measured_stack, timeout_secs=args.measure_timeout, quarantine=quar,
-        fallback=surrogate)
+        fallback=surrogate, verifier=verifier)
+    guarded = resilient
+    corrupt_injector = None
+    if corrupt_specs:
+        from tenzing_tpu.fault import FaultInjectingBenchmarker
+
+        corrupt_injector = FaultInjectingBenchmarker(
+            resilient, corrupt_specs,
+            unsound_check=lambda o: not verifier(o).ok)
+        guarded = corrupt_injector
     bench = CachingBenchmarker(
-        JournalingBenchmarker(resilient, ckpt) if ckpt else resilient)
+        JournalingBenchmarker(guarded, ckpt) if ckpt else guarded)
     if ckpt is not None:
         config = {"workload": args.workload, "metric": metric,
                   "smoke": bool(args.smoke), "seed_topk": args.seed_topk}
@@ -527,13 +565,26 @@ def main() -> int:
                 "checkpoint: recorded config differs from this run "
                 f"({prior.get('config')} vs {config}); journal rows that "
                 "do not resolve against this workload are skipped\n")
+        want_inject = args.inject_faults or None
+        if args.resume and prior is not None and \
+                prior.get("inject") != want_inject:
+            # a resumed chaos run whose injection spec disagrees with the
+            # one the checkpoint was written under would replay journaled
+            # answers from a DIFFERENT fault universe and silently diverge
+            # from both the original run and a clean rerun — refuse loudly
+            ap.error(
+                "--resume: this run's --inject-faults "
+                f"({want_inject!r}) disagrees with the checkpoint's "
+                f"recorded injection spec ({prior.get('inject')!r}); "
+                "use the same spec (including seeds) or start a fresh "
+                "checkpoint directory")
         if args.resume:
             restored = ckpt.restore_into(
                 bench, g, log=lambda m: sys.stderr.write(m + "\n"))
             sys.stderr.write(
                 f"resume: {restored} recorded measurement(s) restored — "
                 "already-measured schedules will not touch the device\n")
-        ckpt.save_state(config=config)
+        ckpt.save_state(config=config, inject=want_inject)
 
         # final snapshots: the journal and quarantine are already on disk
         # (appended/rewritten as each measurement landed), so these only
@@ -579,6 +630,15 @@ def main() -> int:
         while not naive_state.is_terminal():
             naive_state = naive_state.apply(naive_state.get_decisions(naive_plat)[0])
         naive_seq = naive_state.sequence
+    # the baseline is not a search candidate: exempt it from the
+    # identity-keyed candidate-fault kinds (deterministic/corrupt), which
+    # would otherwise deterministically kill the run under ~rate of the
+    # seeds before the search starts.  Tunnel-fault kinds still apply.
+    for inj in (injector, corrupt_injector):
+        if inj is not None:
+            from tenzing_tpu.bench.benchmarker import schedule_id as _sid
+
+            inj.exempt_ids.add(_sid(naive_seq))
     t0 = time.time()
     naive = bench.benchmark(naive_seq, opts)
     sys.stderr.write(f"naive: pct50={naive.pct50*1e6:.1f}us (wall {time.time()-t0:.0f}s)\n")
@@ -877,7 +937,7 @@ def main() -> int:
         MctsOpts(n_iters=args.mcts_iters, bench_opts=mcts_confirm,
                  screen_opts=mcts_screen, confirm_topk=4, seed=0,
                  rollout_policy=mcts_rollout_policy,
-                 checkpoint=ckpt),
+                 checkpoint=ckpt, verify=verifier),
         strategy=FastMin,
         seeds=seed_paths,
     )
@@ -1020,7 +1080,8 @@ def main() -> int:
                 g, cplat, bench, cphases, prefer=cprefer, priority=cpriority,
                 opts=LocalOpts(budget=cbudget, bench_opts=climb_opts,
                                seed=2 + ci, paired=True,
-                               prescreen=surrogate, checkpoint=ckpt),
+                               prescreen=surrogate, checkpoint=ckpt,
+                               verify=verifier),
             )
             lbest = lres.best()
             sys.stderr.write(
@@ -1228,6 +1289,79 @@ def main() -> int:
             value_us = fin_naive.pct50 * 1e6
             vs = 1.0
 
+    # result-integrity gate (docs/robustness.md, "Schedule soundness"): the
+    # schedule whose number the JSON is about to report re-executes on the
+    # device next to naive, and their outputs must numerically agree — plus
+    # the independent verifier must pass it.  A fast-but-WRONG schedule
+    # (an under-synchronized winner whose race made it fast) can therefore
+    # never be the answer: a failed gate demotes the run to no-win and
+    # stamps ``verified: false`` with the verdict into the fault meta.
+    integrity = None
+    if verifier is not None and not resilient.degraded:
+        winner_seq = (top[best_i].order if top and finals and vs > 1.0
+                      else naive_seq)
+        verdict = verifier(winner_seq)
+        num_ok = False
+        gate_err = None
+        try:
+            import numpy as _np
+
+            from tenzing_tpu.fault.backoff import (
+                BackoffPolicy as _GP,
+                retry_call as _gate_retry,
+            )
+
+            t0 = time.time()
+            # transient-classified retry (default retry_on), like every
+            # other device interaction: one tunnel flake must not demote a
+            # multi-hour search's legitimate winner to verified: false
+            out_w = _gate_retry(lambda: ex.run(winner_seq),
+                                policy=_GP(retries=2, base_secs=2.0),
+                                where="verify.gate")
+            out_n = (out_w if winner_seq is naive_seq
+                     else _gate_retry(lambda: ex.run(naive_seq),
+                                      policy=_GP(retries=2, base_secs=2.0),
+                                      where="verify.gate"))
+            num_ok = True
+            mismatched = []
+            for name in sorted(set(out_n) & set(out_w)):
+                import jax as _jax
+
+                a = _np.asarray(_jax.device_get(out_n[name]),
+                                dtype=_np.float64)
+                b = _np.asarray(_jax.device_get(out_w[name]),
+                                dtype=_np.float64)
+                if a.shape != b.shape or not _np.allclose(
+                        a, b, rtol=args.verify_tol,
+                        atol=args.verify_tol * 1e-3, equal_nan=True):
+                    num_ok = False
+                    mismatched.append(name)
+            if mismatched:
+                gate_err = f"outputs diverge on {mismatched[:4]}"
+            sys.stderr.write(
+                "integrity gate: winner-vs-naive outputs "
+                f"{'agree' if num_ok else 'DIVERGE'}, verifier "
+                f"{'ok' if verdict.ok else 'UNSOUND'} "
+                f"(wall {time.time()-t0:.0f}s)\n")
+        except Exception as e:
+            gate_err = f"{type(e).__name__}: {str(e)[:200]}"
+            sys.stderr.write(
+                f"integrity gate: winner re-execution failed ({gate_err})\n")
+        integrity = {"verified": bool(verdict.ok and num_ok)}
+        if not verdict.ok:
+            integrity["verdict"] = verdict.witness()
+        if gate_err is not None:
+            integrity["error"] = gate_err
+        if not integrity["verified"] and vs > 1.0:
+            sys.stderr.write(
+                "integrity gate FAILED — demoting the winner to no-win\n")
+            value_us = (finals[0].pct50 if finals else naive.pct50) * 1e6
+            vs = 1.0
+    elif verifier is not None:
+        # degraded: no device to re-execute on — the answer is explicitly
+        # NOT verified (and already demoted to the pre-loss naive number)
+        integrity = {"verified": False, "error": "degraded: no device"}
+
     if args.dump_csv:
         # One row per distinct schedule.  The decorrelated final-batch results
         # *supersede* the search-time measurements for naive and the finalists
@@ -1306,13 +1440,23 @@ def main() -> int:
     # -heavy run must be visible in the parsed metric series, not only in
     # stderr.  ``resumed`` distinguishes a continued run's numbers (its
     # search-phase measurements may predate the current chip regime).
-    if resilient.degraded or len(quar) or args.resume or injector is not None:
+    # ``verified`` (ISSUE 4) is the result-integrity gate's stamp: the
+    # reported answer re-executed on device with outputs matching naive AND
+    # passed the independent soundness verifier.
+    injected: dict = {}
+    for inj in (injector, corrupt_injector):
+        if inj is not None:
+            for k, v in inj.injected.items():
+                if v:
+                    injected[k] = injected.get(k, 0) + v
+    if (resilient.degraded or len(quar) or args.resume or injected
+            or integrity is not None):
         meta["fault"] = {
             "degraded": resilient.degraded,
             "quarantined": len(quar),
             "resumed": bool(args.resume),
-            **({"injected": {k: v for k, v in injector.injected.items() if v}}
-               if injector is not None else {}),
+            **({"injected": injected} if injected else {}),
+            **(integrity if integrity is not None else {}),
         }
     write_telemetry()
     print(

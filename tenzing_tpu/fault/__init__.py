@@ -36,6 +36,7 @@ from tenzing_tpu.fault.errors import (
     MeasurementTimeout,
     QuarantinedScheduleError,
     TransientError,
+    UnsoundScheduleError,
     classify_error,
     fault_code,
 )
@@ -44,6 +45,7 @@ from tenzing_tpu.fault.inject import (
     InjectSpec,
     InjectedDeterministicError,
     InjectedTransientError,
+    corrupt_schedule,
     parse_inject_specs,
 )
 from tenzing_tpu.fault.quarantine import Quarantine
@@ -66,8 +68,10 @@ __all__ = [
     "ResilientBenchmarker",
     "SearchCheckpoint",
     "TransientError",
+    "UnsoundScheduleError",
     "atomic_write_json",
     "classify_error",
+    "corrupt_schedule",
     "fault_code",
     "parse_inject_specs",
     "read_checked_json",

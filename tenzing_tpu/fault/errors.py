@@ -64,6 +64,13 @@ class QuarantinedScheduleError(DeterministicScheduleError):
     """Raised instead of re-measuring a schedule already quarantined."""
 
 
+class UnsoundScheduleError(DeterministicScheduleError):
+    """The independent soundness verifier (tenzing_tpu/verify) rejected the
+    schedule: a data dependency is unordered or a cross-lane race exists.
+    Deterministic by nature — the schedule is *wrong*, not unlucky — so the
+    resilient layer quarantines it and it is never measured."""
+
+
 class DeviceLostError(RuntimeError):
     """The device is unrecoverable; escalate (degrade or abort)."""
 

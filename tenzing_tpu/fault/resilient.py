@@ -24,6 +24,14 @@ fault policy of docs/robustness.md:
   together, instead of one rank raising while its peers deadlock in the
   next collective.  The watchdog is what guarantees a hung rank eventually
   *reaches* the agreement point.
+* **soundness gate** — with a ``verifier`` configured (the independent
+  happens-before checker, tenzing_tpu/verify), every schedule is verified
+  *before* it is measured: an unsound schedule — a dropped or mis-ordered
+  sync, whether from a synthesizer bug or injected corruption — is a
+  deterministic fault discovered for free (no device time), quarantined
+  with a ``verify.unsound`` obs event, and refused as
+  :class:`~tenzing_tpu.fault.errors.UnsoundScheduleError`.  A
+  fast-but-wrong schedule can therefore never produce a measurement.
 * **graceful degradation** — on device loss with a ``fallback`` benchmarker
   configured (e.g. the PR 2 learned surrogate), the wrapper flips to
   answering every subsequent query from the fallback, records which
@@ -52,6 +60,7 @@ from tenzing_tpu.fault.errors import (
     FaultClass,
     MeasurementTimeout,
     QuarantinedScheduleError,
+    UnsoundScheduleError,
     classify_error,
 )
 from tenzing_tpu.fault.quarantine import Quarantine
@@ -76,6 +85,7 @@ class ResilientBenchmarker:
         fallback=None,
         sleep=time.sleep,
         seed: int = 0,
+        verifier=None,
     ):
         self.inner = inner
         self.cp = control_plane if control_plane is not None else (
@@ -84,6 +94,13 @@ class ResilientBenchmarker:
         self.policy = policy if policy is not None else BackoffPolicy()
         self.quarantine = quarantine if quarantine is not None else Quarantine()
         self.fallback = fallback
+        # independent soundness gate (tenzing_tpu/verify.ScheduleVerifier):
+        # an unsound schedule is a deterministic fault discovered WITHOUT
+        # touching the device — quarantined and refused, never measured.
+        # Verification is a pure function of the (broadcast-identical)
+        # schedule, so every rank reaches the same verdict at the same
+        # point: no agreement round needed, the protocol stays in lockstep.
+        self.verifier = verifier
         self._sleep = sleep
         self._rng = _random.Random(seed)
         self.degraded = False
@@ -98,6 +115,25 @@ class ResilientBenchmarker:
         """True if a query for ``order`` was answered by the fallback after
         device loss — dump paths tag such rows ``fid=degraded``."""
         return schedule_id(order) in self._degraded_keys
+
+    # -- soundness gate ----------------------------------------------------
+    def _check_sound(self, order) -> None:
+        """Refuse an unsound schedule before it reaches the device: the
+        independent verifier's rejection is classified deterministic (the
+        schedule is wrong, not unlucky), quarantined, and raised as
+        :class:`UnsoundScheduleError` with the minimal witness."""
+        if self.verifier is None:
+            return
+        verdict = self.verifier(order)
+        if verdict.ok:
+            return
+        from tenzing_tpu.verify.soundness import report_unsound
+
+        report_unsound("resilient.benchmark", order, verdict)
+        err = UnsoundScheduleError(
+            f"schedule fails soundness verification: {verdict.witness()}")
+        self.quarantine.add(order, err, FaultClass.DETERMINISTIC)
+        raise err
 
     # -- watchdog ----------------------------------------------------------
     def _call_with_timeout(self, fn, *args, **kwargs):
@@ -171,6 +207,7 @@ class ResilientBenchmarker:
             raise QuarantinedScheduleError(
                 f"schedule quarantined ({rec.get('error')}: "
                 f"{rec.get('message', '')[:200]})")
+        self._check_sound(order)
         tr = get_tracer()
         reg = get_metrics()
         attempts = self.policy.retries + 1
@@ -260,6 +297,11 @@ class ResilientBenchmarker:
         if self.degraded:
             raise DeviceLostError(
                 "batch benchmarking unavailable in degraded mode")
+        # soundness-gate every member up front: unlike a runtime batch
+        # failure, verification attributes the fault to ONE schedule, so
+        # the unsound member is quarantined before anything is measured
+        for order in orders:
+            self._check_sound(order)
         timeout = (None if self.timeout_secs is None
                    else self.timeout_secs * max(1, len(orders)))
         tr = get_tracer()

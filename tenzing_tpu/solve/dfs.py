@@ -63,6 +63,11 @@ class DfsOpts:
     # re-enumerates (deterministic) and the journal-restored cache answers
     # every already-measured terminal instantly (docs/robustness.md)
     checkpoint: Optional[object] = None
+    # independent soundness gate (verify.ScheduleVerifier): every
+    # enumerated terminal is verified before it is benchmarked; unsound
+    # terminals are rejected with a ``verify.unsound`` event instead of
+    # being measured (docs/robustness.md, "Schedule soundness")
+    verify: Optional[object] = None
 
     def to_json(self) -> dict:
         """Provenance stamp of the options (reference dfs.cpp:11-14)."""
@@ -353,6 +358,20 @@ def explore(
                     )
             if opts.batch and batch_times_fn is not None and cp.size() == 1:
                 orders = [st.sequence for st in states]
+                if opts.verify is not None:
+                    from tenzing_tpu.verify.soundness import report_unsound
+
+                    kept = []
+                    for o in orders:
+                        verdict = opts.verify(o)
+                        if verdict.ok:
+                            kept.append(o)
+                            continue
+                        report_unsound("dfs.benchmark", o, verdict)
+                        reporter.warn(
+                            "tenzing-tpu: dfs terminal rejected by the "
+                            f"soundness verifier ({verdict.witness()})")
+                    orders = kept
                 times: List[List[float]] = [[] for _ in orders]
                 batch_partial.update(orders=orders, times=times)
                 with counters.phase("BENCHMARK"):
@@ -391,6 +410,24 @@ def explore(
                             order = st.sequence
                         else:
                             order = sequence_from_json(payload, graph)
+                        if opts.verify is not None:
+                            verdict = opts.verify(order)
+                            if not verdict.ok:
+                                from tenzing_tpu.verify.soundness import (
+                                    report_unsound,
+                                )
+
+                                # deterministic + device-free: every rank
+                                # reaches the same verdict, so the coherent
+                                # skip needs no agreement round
+                                report_unsound("dfs.benchmark", order,
+                                               verdict)
+                                reporter.warn(
+                                    "tenzing-tpu: dfs terminal rejected by "
+                                    "the soundness verifier "
+                                    f"({verdict.witness()})", i=i)
+                                sp.set("unsound", True)
+                                continue
                         with counters.phase("BENCHMARK"):
                             try:
                                 res = benchmarker.benchmark(

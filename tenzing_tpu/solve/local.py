@@ -196,6 +196,10 @@ class LocalOpts:
     # — the budget is re-spent only on schedules never measured before), so
     # the accepted chain reconstructs deterministically
     checkpoint: Optional[object] = None
+    # independent soundness gate (verify.ScheduleVerifier): the incumbent
+    # and every neighbor are verified before they are measured; an unsound
+    # neighbor is rejected like one that failed to compile
+    verify: Optional[object] = None
 
 
 @dataclass
@@ -225,12 +229,33 @@ def hill_climb(
     fresh = lambda: phase_policy(platform, phases, prefer, priority)
     result = LocalResult()
 
+    def unsound(seq_, where):
+        """True (and reported) when the soundness gate rejects ``seq_`` —
+        the climb treats it exactly like a neighbor that failed to
+        compile, without spending any device time."""
+        if opts.verify is None:
+            return False
+        verdict = opts.verify(seq_)
+        if verdict.ok:
+            return False
+        import sys
+
+        from tenzing_tpu.verify.soundness import report_unsound
+
+        report_unsound(where, seq_, verdict)
+        sys.stderr.write(
+            "hill-climb: schedule rejected by the soundness verifier "
+            f"({verdict.witness()})\n")
+        return True
+
     def measured(seq_):
         """Benchmark + record; returns (result | None, charge) where
         ``charge`` is False for a cache hit (instant, no device time) — the
         single free-cache-hit policy both the incumbent and the neighbor loop
         use.  ``None`` result = the schedule failed to compile/run (rejected,
         same policy as paired_step)."""
+        if unsound(seq_, "local.measure"):
+            return None, False
         pre_hits = getattr(benchmarker, "hits", None)
         try:
             res = benchmarker.benchmark(seq_, opts.bench_opts)
@@ -250,24 +275,32 @@ def hill_climb(
         result.sims.append(SimResult(order=seq_, result=res))
         return res, pre_hits is None or benchmarker.hits == pre_hits
 
+    batch_owner = benchmarker
     batcher = getattr(benchmarker, "benchmark_batch_times", None)
     if batcher is None:
-        inner = getattr(benchmarker, "inner", None)
-        batcher = getattr(inner, "benchmark_batch_times", None)
+        batch_owner = getattr(benchmarker, "inner", None)
+        batcher = getattr(batch_owner, "benchmark_batch_times", None)
     use_paired = opts.paired and batcher is not None
 
     def paired_step(cur_seq, cand_seq):
-        """(candidate BenchResult | None, accept) from one decorrelated
-        2-schedule batch: accept only when the paired cur/cand ratio's CI
-        clears 1.0.  A neighbor that fails to COMPILE (e.g. an ordering whose
-        liveness needs more HBM than the chip has — observed on the halo
-        flagship: several multi-GB grid versions kept alive at once) is a
-        reject, not a crash: infeasible-on-hardware is a legitimate verdict
-        for a schedule."""
+        """(candidate BenchResult | None, accept, charge) from one
+        decorrelated 2-schedule batch: accept only when the paired cur/cand
+        ratio's CI clears 1.0; ``charge`` is False when the batch was
+        answered from a journal replay (JournalingBenchmarker.batch_hits —
+        the same free-cache-hit budget policy as ``measured``, so a resumed
+        climb re-spends budget only on batches never run before).  A
+        neighbor that fails to COMPILE (e.g. an ordering whose liveness
+        needs more HBM than the chip has — observed on the halo flagship:
+        several multi-GB grid versions kept alive at once) is a reject, not
+        a crash: infeasible-on-hardware is a legitimate verdict for a
+        schedule."""
         from tenzing_tpu.bench.benchmarker import BenchResult
         from tenzing_tpu.utils.numeric import paired_speedup
 
         pair_seed = rng.randrange(1 << 30)
+        if unsound(cand_seq, "local.paired"):
+            return None, False, False
+        pre_hits = getattr(batch_owner, "batch_hits", None)
         try:
             times = batcher([cur_seq, cand_seq], opts.bench_opts, seed=pair_seed)
         except Exception as e:  # compile/runtime failure of the candidate
@@ -282,11 +315,12 @@ def hill_climb(
                 "hill-climb: neighbor rejected (failed to compile/run: "
                 f"{type(e).__name__}: {str(e)[:200]})\n"
             )
-            return None, False
+            return None, False, True
+        charge = pre_hits is None or batch_owner.batch_hits == pre_hits
         m, lo, _ = paired_speedup(times[0], times[1], seed=pair_seed + 1)
         res = BenchResult.from_times(times[1])
         result.sims.append(SimResult(order=cand_seq, result=res))
-        return res, (m > 1.0 and lo > 1.0)
+        return res, (m > 1.0 and lo > 1.0), charge
 
     seq, decisions = drive(graph, platform, fresh())
     cur, charge = measured(seq)
@@ -355,8 +389,9 @@ def hill_climb(
                                      schedule=schedule_id(cand_seq))
                         continue
                 if use_paired:
-                    res, accept = paired_step(seq, cand_seq)
-                    spent += 1
+                    res, accept, charge = paired_step(seq, cand_seq)
+                    if charge:
+                        spent += 1
                 else:
                     res, charge = measured(cand_seq)
                     if charge:

@@ -75,6 +75,13 @@ class MctsOpts:
     # deterministic search against the journal-restored benchmark cache,
     # reconstructing the tree exactly (docs/robustness.md)
     checkpoint: Optional[object] = None
+    # independent soundness gate (verify.ScheduleVerifier): every rollout —
+    # i.e. the output of EventSynchronizer-driven construction PLUS
+    # remove_redundant_syncs — is verified before it is benchmarked; an
+    # unsound schedule is rejected like a failed compile (penalty backprop,
+    # negative-cached) and a ``verify.unsound`` event lands in the trace.
+    # Deterministic and device-free, so identical on every rank.
+    verify: Optional[object] = None
 
     def to_json(self) -> dict:
         return {
@@ -297,6 +304,17 @@ def explore(
                 ropts = opts.screen_opts if opts.screen_opts is not None else (
                     opts.bench_opts)
                 res: Optional[BenchResult] = None
+                if key not in failed_keys and opts.verify is not None:
+                    verdict = opts.verify(order)
+                    if not verdict.ok:
+                        from tenzing_tpu.verify.soundness import report_unsound
+
+                        report_unsound("mcts.rollout", order, verdict)
+                        reporter.warn(
+                            "mcts: rollout rejected by the soundness "
+                            f"verifier ({verdict.witness()})", it=it)
+                        it_sp.set("unsound", True)
+                        failed_keys.add(key)
                 if key not in failed_keys:
                     with counters.phase("BENCHMARK"):
                         try:

@@ -1,0 +1,33 @@
+"""Independent schedule-soundness verification (docs/robustness.md,
+"Schedule soundness").
+
+The search stack's correctness story used to rest entirely on the
+:class:`~tenzing_tpu.core.event_synchronizer.EventSynchronizer` that *built*
+the schedules; this package is the separate pair of eyes: a static
+happens-before reconstruction over a complete schedule that proves every
+graph data dependency ordered and classifies anything unordered as the
+cross-lane RAW/WAR/WAW race it is — wired as a guard into the resilient
+measurement stack and all three solvers' accept points, and backing the
+driver's final result-integrity gate (``bench.py``: winner re-executed vs
+naive, outputs compared, ``verified`` stamped into the JSON).
+"""
+
+from tenzing_tpu.verify.soundness import (
+    ScheduleVerifier,
+    Soundness,
+    Violation,
+    happens_before_masks,
+    project_graph,
+    report_unsound,
+    verify_schedule,
+)
+
+__all__ = [
+    "ScheduleVerifier",
+    "Soundness",
+    "Violation",
+    "happens_before_masks",
+    "project_graph",
+    "report_unsound",
+    "verify_schedule",
+]

@@ -368,6 +368,87 @@ def test_resume_after_interrupt_no_remeasure_and_same_best(
         opts["n_iters"] - 1
 
 
+CORRUPT_SPEC = InjectSpec("corrupt", 0.25, 7)
+
+
+def test_corruption_chaos_every_mutation_caught(tmp_path, tracer, registry,
+                                                corpus):
+    """Corruption-chaos acceptance (ISSUE 4): a seeded MCTS + exhaustive
+    DFS with >= 20% schedule corruption — sync ops dropped/reordered by the
+    injector, with the ORIGINAL oracle (EventSynchronizer, via
+    tests/test_verify.oracle_unsound_check) deciding which mutations count,
+    so the verifier under test is never consulted to pick them — must:
+
+    * have every mutated candidate caught by the independent verifier and
+      quarantined: ZERO unsound schedules measured;
+    * still find the clean run's best schedule (the corruption seed is
+      precondition-checked not to hit the best candidate, the same pattern
+      as DET_SPEC above);
+    * emit a ``verify.unsound`` event per catch.
+    """
+    from tenzing_tpu.bench.benchmarker import schedule_id
+    from tenzing_tpu.solve.dfs import expand_all
+    from tenzing_tpu.verify import ScheduleVerifier, verify_schedule
+
+    from tests.test_verify import oracle_unsound_check
+
+    rows, terminals = corpus
+    g = _graph()
+    plat = Platform.make_n_lanes(2)
+
+    # clean reference
+    mcts_clean = explore(g, plat, mk_db(rows), MctsOpts(n_iters=30, seed=3))
+    dfs_clean = dfs_explore(g, plat, mk_db(rows), DfsOpts(max_seqs=10_000))
+    clean_key, clean_pct50 = _best(mcts_clean.sims + dfs_clean.sims)
+
+    # precondition: the corruption seed must not hit the best schedule in
+    # either spelling the solvers query (a corrupted best is legitimately
+    # unfindable — the run would catch it, but could not measure it)
+    best_raw = min(terminals, key=lambda s: _synth_result(s).pct50)
+    for spelling in (best_raw, remove_redundant_syncs(best_raw)):
+        assert not _schedule_fails(schedule_id(spelling), CORRUPT_SPEC)
+
+    # chaos stack: the corrupt injector sits ABOVE the resilient layer so
+    # the verifier gate sees the mutated schedule (the bench.py layering)
+    qpath = str(tmp_path / "quarantine.json")
+    verifier = ScheduleVerifier(g)
+    counting = CountingInner(mk_db(rows))
+    quar = Quarantine(qpath)
+    resilient = ResilientBenchmarker(
+        counting, policy=_fast_policy(), quarantine=quar,
+        verifier=verifier, sleep=lambda s: None)
+    inject = FaultInjectingBenchmarker(
+        resilient, [CORRUPT_SPEC],
+        unsound_check=oracle_unsound_check(expand_all(g.clone())))
+    bench = CachingBenchmarker(inject)
+
+    res_mcts = explore(g, plat, bench, MctsOpts(n_iters=30, seed=3))
+    res_dfs = dfs_explore(g, plat, bench, DfsOpts(max_seqs=10_000))
+
+    # the chaos actually happened: >= 20% of the distinct candidates were
+    # mutated (seeded by schedule identity at rate 0.25)
+    assert inject.injected["corrupt"] >= 1
+    assert len(inject.corrupted) >= 0.15 * len(terminals)
+
+    # every mutated schedule was caught and quarantined; none was measured
+    measured_sids = set(counting.by_sid)
+    for orig, mutated in inject.corrupted.items():
+        assert mutated in quar.entries, "a corruption escaped the verifier"
+        assert mutated not in measured_sids
+    # zero unsound schedules measured, full stop: everything that reached
+    # the inner "device" re-verifies clean
+    for order in counting.orders.values():
+        assert verify_schedule(order, g).ok
+    unsound_events = [e for e in tracer.events()
+                      if e.name == "verify.unsound"]
+    assert len(unsound_events) >= len(inject.corrupted)
+    assert registry.counter("verify.unsound").value >= len(inject.corrupted)
+
+    # the clean-run best was still found, with the identical measurement
+    chaos_key, chaos_pct50 = _best(res_mcts.sims + res_dfs.sims)
+    assert (chaos_key, chaos_pct50) == (clean_key, clean_pct50)
+
+
 def test_device_lost_without_fallback_escalates_out_of_search(corpus):
     """Device loss is fatal, never a per-candidate verdict: with no
     degradation fallback the search must abort, not grind through every

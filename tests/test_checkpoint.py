@@ -212,3 +212,125 @@ def test_journaling_benchmarker_tags_degraded(tmp_path, seqs):
     JournalingBenchmarker(DegradedInner(), ck).benchmark(seqs[0], None)
     (_, _, _, prov), = ck.load_measurements(_graph())
     assert prov == PROVENANCE_DEGRADED
+
+
+# -- paired-batch journal + resume (ISSUE 4 satellite) ----------------------
+
+import hashlib
+
+from tenzing_tpu.core.sequence import canonical_key
+
+
+class SynthBatchBench:
+    """Deterministic device stand-in offering the batch protocol: times are
+    a pure function of the schedule's canonical identity, so an original
+    run and its resume are comparable bit-for-bit."""
+
+    def __init__(self):
+        self.calls = 0
+        self.batch_calls = 0
+
+    def _t(self, order):
+        h = hashlib.sha256(repr(canonical_key(order)).encode()).digest()
+        return 1.0 + int.from_bytes(h[:8], "big") / float(1 << 64)
+
+    def benchmark(self, order, opts=None):
+        self.calls += 1
+        t = self._t(order)
+        return BenchResult.from_times([t, t, t])
+
+    def benchmark_batch_times(self, orders, opts=None, seed=0,
+                              times_out=None):
+        self.batch_calls += 1
+        times = [[self._t(o)] * 4 for o in orders]
+        if times_out is not None:
+            for dst, src in zip(times_out, times):
+                dst.clear()
+                dst.extend(src)
+            return times_out
+        return times
+
+
+def test_batch_journal_round_trips(tmp_path, seqs):
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    opts = BenchOpts(n_iters=4)
+    ck.record_batch(["ida", "idb"], opts, 17, [[1.0, 2.0], [3.0, 4.0]])
+    ck.record_batch(["ida", "idb"], opts, 18, [[5.0], [6.0]])
+    got = SearchCheckpoint(str(tmp_path / "ckpt")).load_batches()
+    key = (("ida", "idb"), 17, (opts.n_iters, opts.max_retries,
+                                opts.target_secs))
+    assert got[key] == [[1.0, 2.0], [3.0, 4.0]]
+    assert len(got) == 2
+    # measurement loader skips batch lines without noise
+    assert SearchCheckpoint(str(tmp_path / "ckpt")).load_measurements(
+        _graph()) == []
+
+
+def test_journaling_batch_replayed_on_resume(tmp_path, seqs):
+    from tenzing_tpu.bench.benchmarker import schedule_id
+
+    ck = SearchCheckpoint(str(tmp_path / "ckpt"))
+    inner1 = SynthBatchBench()
+    jb1 = JournalingBenchmarker(inner1, ck)
+    opts = BenchOpts(n_iters=2)
+    t1 = jb1.benchmark_batch_times(seqs[:2], opts, seed=9)
+    assert inner1.batch_calls == 1
+    # same key, same process: answered from the in-memory batch cache
+    assert jb1.benchmark_batch_times(seqs[:2], opts, seed=9) == t1
+    assert inner1.batch_calls == 1
+    # a different seed is a different decorrelation draw: re-measured
+    jb1.benchmark_batch_times(seqs[:2], opts, seed=10)
+    assert inner1.batch_calls == 2
+
+    # restart: restore_into finds the JournalingBenchmarker on the chain
+    ck2 = SearchCheckpoint(str(tmp_path / "ckpt"))
+    inner2 = SynthBatchBench()
+    jb2 = JournalingBenchmarker(inner2, ck2)
+    bench2 = CachingBenchmarker(jb2)
+    ck2.restore_into(bench2, _graph())
+    out = jb2.benchmark_batch_times(seqs[:2], opts, seed=9,
+                                    times_out=[[], []])
+    assert out == t1
+    assert inner2.batch_calls == 0  # replayed, not re-run
+
+
+def test_resumed_paired_climb_runs_zero_batches(tmp_path):
+    """The ROADMAP paired-resume item: a resumed paired hill-climb answers
+    its incumbent measurement from the journal and EVERY accept batch from
+    the batch journal — 0 compiles, 0 device batches — and reconstructs the
+    identical accepted chain."""
+    from tenzing_tpu.solve.local import LocalOpts, hill_climb
+
+    g = _graph()
+    plat = Platform.make_n_lanes(2)
+    phases = ("scatter", "exchange", "spmv", "y_add")
+    # budget generous enough that the climb CONVERGES (a full sweep with no
+    # improvement) instead of stopping mid-sweep on budget: a converged
+    # climb replays to the identical end state with nothing left to try
+    lopts = dict(budget=200, paired=True, seed=11,
+                 bench_opts=BenchOpts(n_iters=2))
+
+    ckdir = str(tmp_path / "ckpt")
+    ck1 = SearchCheckpoint(ckdir)
+    inner1 = SynthBatchBench()
+    bench1 = CachingBenchmarker(JournalingBenchmarker(inner1, ck1))
+    res1 = hill_climb(g, plat, bench1, phases,
+                      opts=LocalOpts(checkpoint=ck1, **lopts))
+    assert inner1.batch_calls > 0  # the climb genuinely ran accept batches
+
+    ck2 = SearchCheckpoint(ckdir)
+    inner2 = SynthBatchBench()
+    bench2 = CachingBenchmarker(JournalingBenchmarker(inner2, ck2))
+    restored = ck2.restore_into(bench2, g)
+    assert restored > 0
+    res2 = hill_climb(g, plat, bench2, phases,
+                      opts=LocalOpts(checkpoint=ck2, **lopts))
+
+    assert inner2.calls == 0  # zero compiles / measurements
+    assert inner2.batch_calls == 0  # zero accept batches re-run
+    assert canonical_key(res2.final.order) == canonical_key(res1.final.order)
+    assert [s.result.pct50 for s in res2.sims] == \
+        [s.result.pct50 for s in res1.sims]
+    # the climb cursor round-tripped through the snapshot
+    assert SearchCheckpoint(ckdir).load_state()["climb"]["n_sims"] == \
+        len(res2.sims)

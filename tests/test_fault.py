@@ -484,18 +484,20 @@ def test_injected_hang_plus_watchdog_end_to_end(tracer):
     the watchdog fire, the timeout classifies transient, the retry passes
     (rate keeps the second draw clean), and the whole failure is visible as
     classified fault.* telemetry."""
-    from random import Random
+    from tenzing_tpu.fault.inject import _attempt_fires
 
     # a seed whose first draw injects the hang and whose second does not,
-    # so the retry after the watchdog timeout recovers
+    # so the retry after the watchdog timeout recovers (draws are keyed on
+    # schedule identity + attempt counter — rank-agreed by construction)
     rate = 0.6
 
     def draws(s):
-        r = Random(s)
-        return r.random(), r.random()
+        spec = InjectSpec("hang", rate, s)
+        sid = schedule_id("sched")
+        return (_attempt_fires(sid, 0, spec), _attempt_fires(sid, 1, spec))
 
     seed = next(s for s in range(1000)
-                if draws(s)[0] < rate and draws(s)[1] >= rate)
+                if draws(s)[0] and not draws(s)[1])
     inj = FaultInjectingBenchmarker(
         ScriptedBench([]), [InjectSpec("hang", rate, seed)],
         hang_secs=30.0)  # real sleep on a daemon thread, abandoned
@@ -542,3 +544,123 @@ def test_resilient_batch_under_watchdog_isolates_caller_lists():
                for attempt in seen_lists for lst in attempt)
     # ...and carry exactly the completed attempt's series, garbage-free
     assert t0 == [1.0] and t1 == [2.0]
+
+
+def test_injection_draws_agree_across_instances():
+    """The rank-agreement substrate (ROADMAP multi-host chaos item): draws
+    are keyed on (kind, seed, schedule identity, per-schedule attempt
+    counter) — two injector instances fed the same benchmark-call sequence
+    (what the broadcast protocol guarantees every rank sees) make
+    IDENTICAL draws, with no shared RNG state.  A restarted process
+    re-counts attempts from zero, so a resumed run replays the same
+    faults too."""
+    specs = [InjectSpec("transient", 0.4, 3), InjectSpec("hang", 0.1, 5)]
+
+    def run():
+        naps = []
+        inj = FaultInjectingBenchmarker(ScriptedBench([]), specs,
+                                        hang_secs=1.0, sleep=naps.append)
+        pattern = []
+        # repeated queries of the same schedules: the attempt counter must
+        # advance the draw (a retry is a fresh coin flip, same on all ranks)
+        for i in [0, 1, 2, 0, 0, 1, 2, 2, 0, 1] * 4:
+            try:
+                inj.benchmark(f"s{i}")
+                pattern.append(0)
+            except InjectedTransientError:
+                pattern.append(1)
+        return pattern, len(naps), inj
+
+    p1, n1, inj1 = run()
+    p2, n2, _ = run()
+    assert p1 == p2 and n1 == n2  # rank-agreed by construction
+    assert sum(p1) > 0 and n1 > 0  # both channels actually fired
+    # ...and the same schedule is NOT deterministically fated: different
+    # attempts of one schedule draw independently
+    by_attempt = [p1[i] for i, q in enumerate([0, 1, 2, 0, 0, 1, 2, 2, 0, 1]
+                                              * 4) if q == 0]
+    assert 0 < sum(by_attempt) < len(by_attempt)
+
+
+def test_corrupt_injection_mutates_by_schedule_identity(registry):
+    """corrupt: draws by schedule identity, mutates via corrupt_schedule,
+    and records original -> mutated ids for accountability."""
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.fault import corrupt_schedule
+    from tenzing_tpu.models.spmv import SpMVCompound
+    from tenzing_tpu.solve.dfs import enumerate_schedules
+
+    g = Graph()
+    g.start_then(SpMVCompound())
+    g.then_finish(SpMVCompound())
+    states = enumerate_schedules(g, Platform.make_n_lanes(2), max_seqs=40)
+    spec = InjectSpec("corrupt", 0.5, 11)
+
+    seen = {}
+
+    class Recorder:
+        def benchmark(self, order, opts=None):
+            seen[schedule_id(order)] = order
+            return BenchResult.from_times([1.0])
+
+    inj = FaultInjectingBenchmarker(Recorder(), [spec])
+    for st in states:
+        inj.benchmark(st.sequence)
+    assert inj.injected["corrupt"] > 0
+    assert set(inj.corrupted) != set(inj.corrupted.values())
+    for orig, mutated in inj.corrupted.items():
+        assert mutated in seen  # the mutation went DOWN the stack
+        assert orig != mutated
+    # replay: identical mutations (content-keyed, no RNG state)
+    inj2 = FaultInjectingBenchmarker(Recorder(), [spec])
+    for st in states:
+        inj2.benchmark(st.sequence)
+    assert inj2.corrupted == inj.corrupted
+    # corrupt_schedule without sync ops has nothing to mutate
+    from tenzing_tpu.core.sequence import Sequence
+
+    assert corrupt_schedule(Sequence([g.start(), g.finish()]), 1) is None
+
+
+def test_injector_forwards_degraded_provenance():
+    """A corrupt injector stacked between the journaling layer and the
+    resilient wrapper must forward was_degraded — otherwise fallback
+    answers would journal as provenance 'measured' and a resumed run
+    would replay predictions as device measurements."""
+    class DegradedInner:
+        def was_degraded(self, order):
+            return order == "degraded-one"
+
+        def benchmark(self, order, opts=None):
+            return BenchResult.from_times([1.0])
+
+    inj = FaultInjectingBenchmarker(DegradedInner(),
+                                    [InjectSpec("corrupt", 1.0, 1)])
+    assert inj.was_degraded("degraded-one") is True
+    assert inj.was_degraded("other") is False
+    # ...and stays False-safe over an inner without the method
+    assert FaultInjectingBenchmarker(
+        ScriptedBench([]), [InjectSpec("corrupt", 1.0, 1)]
+    ).was_degraded("x") is False
+
+
+def test_exempt_ids_skip_identity_keyed_kinds_only():
+    """bench.py registers its naive baseline here: identity-keyed
+    candidate-fault kinds (deterministic/corrupt) skip exempt schedules —
+    a seed deterministically breaking the BASELINE would kill every run —
+    while per-attempt tunnel-fault kinds still apply to them."""
+    det = InjectSpec("deterministic", 0.5, 123)
+    # a schedule this seed deterministically fails
+    fails = next(f"s{i}" for i in range(50)
+                 if _schedule_fails(schedule_id(f"s{i}"), det))
+    inj = FaultInjectingBenchmarker(ScriptedBench([]), [det],
+                                    exempt_ids={schedule_id(fails)})
+    inj.benchmark(fails)  # exempt: no raise
+    assert inj.injected["deterministic"] == 0
+    # transient still fires on an exempt schedule (per-attempt kind)
+    tr = InjectSpec("transient", 1.0, 1)
+    inj2 = FaultInjectingBenchmarker(ScriptedBench([]), [tr],
+                                     exempt_ids={schedule_id(fails)})
+    with pytest.raises(InjectedTransientError):
+        inj2.benchmark(fails)
