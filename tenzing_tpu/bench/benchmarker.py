@@ -47,16 +47,25 @@ def schedule_id(order) -> str:
     ``obs.tracer.short_digest`` of its serialized form (works for Sequence
     orders and the CallableRunner's plain string names alike).  Deterministic
     across processes — multi-host trace bundles and archived JSONL agree on
-    ids without coordination."""
+    ids without coordination.  Memoized on the sequence (``Sequence.cached``,
+    invalidated on mutation): every benchmark/cache/verify/journal/injection
+    layer derives the id of the same order, and each derivation used to
+    re-serialize the whole schedule to JSON."""
     if isinstance(order, str):
         return order
-    try:
-        from tenzing_tpu.core.serdes import sequence_to_json_str
 
-        payload = sequence_to_json_str(order)
-    except Exception:
-        payload = repr(order)
-    return short_digest(payload)
+    def derive() -> str:
+        try:
+            from tenzing_tpu.core.serdes import sequence_to_json_str
+
+            payload = sequence_to_json_str(order)
+        except Exception:
+            payload = repr(order)
+        return short_digest(payload)
+
+    if isinstance(order, Sequence):
+        return order.cached("schedule_id", derive)
+    return derive()
 
 
 def candidate_failed(where: str, order, exc: BaseException) -> None:

@@ -38,6 +38,14 @@ def sequence_to_json(seq: Sequence) -> List[Dict[str, Any]]:
 
 
 def sequence_to_json_str(seq: Sequence) -> str:
+    """Serialized schedule, memoized on the sequence: the executor's program
+    cache, schedule ids, and the journal all key on this string for the same
+    object many times per search (``Sequence.cached`` invalidates on
+    mutation).  The per-op dict list from :func:`sequence_to_json` is NOT
+    memoized — callers may mutate it."""
+    if isinstance(seq, Sequence):
+        return seq.cached(
+            "json_str", lambda: json.dumps(sequence_to_json(seq)))
     return json.dumps(sequence_to_json(seq))
 
 

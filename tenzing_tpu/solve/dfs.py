@@ -68,6 +68,14 @@ class DfsOpts:
     # terminals are rejected with a ``verify.unsound`` event instead of
     # being measured (docs/robustness.md, "Schedule soundness")
     verify: Optional[object] = None
+    # compile prefetcher (bench.pipeline.PrefetchingBenchmarker): the next
+    # ``prefetch_lookahead`` terminals of the enumerated frontier are hinted
+    # each iteration, so terminal i+1 compiles in the background while
+    # terminal i measures (the batch path needs no hint here — a prefetcher
+    # in the benchmark stack prefetches the whole batch itself).  Hints are
+    # advisory; None (the default) is bit-identical to today.
+    prefetch: Optional[object] = None
+    prefetch_lookahead: int = 4
 
     def to_json(self) -> dict:
         """Provenance stamp of the options (reference dfs.cpp:11-14)."""
@@ -374,6 +382,10 @@ def explore(
                     orders = kept
                 times: List[List[float]] = [[] for _ in orders]
                 batch_partial.update(orders=orders, times=times)
+                # no explicit hint here: a prefetcher sitting in the
+                # benchmark stack already prefetches the whole batch as the
+                # first statement of its benchmark_batch_times forward
+                # (bench/pipeline.py) — a second hint would be dead weight
                 with counters.phase("BENCHMARK"):
                     batch_times_fn(
                         orders, opts.bench_opts, seed=opts.batch_seed,
@@ -402,6 +414,17 @@ def explore(
                         if cp.rank() == 0:
                             st = states[i]
                             payload = sequence_to_json(st.sequence)
+                            if opts.prefetch is not None:
+                                # frontier slice: the next terminals are
+                                # known — compile them while this one
+                                # measures.  Re-offering the window each
+                                # iteration is cheap (id dedup) and lets
+                                # hints dropped at a full queue resubmit.
+                                opts.prefetch.prefetch(
+                                    [states[j].sequence for j in range(
+                                        i + 1,
+                                        min(n, i + 1 +
+                                            opts.prefetch_lookahead))])
                         else:
                             st, payload = None, None
                         with counters.phase("BCAST"):

@@ -200,6 +200,12 @@ class LocalOpts:
     # and every neighbor are verified before they are measured; an unsound
     # neighbor is rejected like one that failed to compile
     verify: Optional[object] = None
+    # compile prefetcher (bench.pipeline.PrefetchingBenchmarker): each
+    # position's neighbor batch is built up front and hinted before the
+    # sequential measure loop, so neighbor k+1's compile overlaps neighbor
+    # k's measurement.  Building the batch early is pure replay (no RNG):
+    # None (the default) is bit-identical to prefetch-off.
+    prefetch: Optional[object] = None
 
 
 @dataclass
@@ -364,10 +370,30 @@ def hill_climb(
             ds = st.get_decisions(platform)
             alts = [d for d in ds if d.key() != decisions[i].key()]
             rng.shuffle(alts)
-            for alt in alts[: opts.max_alts_per_step]:
-                cand_seq, cand_dec = replay_with_substitution(
-                    graph, platform, decisions, i, alt, fresh()
+            if opts.prefetch is not None:
+                # the whole neighbor batch is materialized before the
+                # measure loop: replay_with_substitution is deterministic
+                # and RNG-free, so building candidate k+1 early changes
+                # nothing — but it lets the prefetcher compile it while
+                # candidate k measures
+                neighbors = [
+                    (alt, *replay_with_substitution(
+                        graph, platform, decisions, i, alt, fresh()))
+                    for alt in alts[: opts.max_alts_per_step]
+                ]
+                opts.prefetch.prefetch(
+                    [cs for _, cs, _ in neighbors
+                     if canonical_key(cs) not in seen])
+            else:
+                # prefetch off: replay lazily, exactly the pre-pipeline
+                # cost model (a first-improvement break pays for no
+                # neighbor it never visits)
+                neighbors = (
+                    (alt, *replay_with_substitution(
+                        graph, platform, decisions, i, alt, fresh()))
+                    for alt in alts[: opts.max_alts_per_step]
                 )
+            for alt, cand_seq, cand_dec in neighbors:
                 key = canonical_key(cand_seq)
                 if key in seen:
                     # a no-op neighbor (e.g. swapping which of two Expands

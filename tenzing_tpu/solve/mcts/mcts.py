@@ -82,6 +82,15 @@ class MctsOpts:
     # negative-cached) and a ``verify.unsound`` event lands in the trace.
     # Deterministic and device-free, so identical on every rank.
     verify: Optional[object] = None
+    # compile prefetcher (bench.pipeline.PrefetchingBenchmarker): candidate
+    # hints — the seed queue up front, speculative completions of the
+    # expanded node's unplayed children per iteration, the confirm queue
+    # before the sequential confirm loop — start background AOT compiles
+    # while the foreground measurement runs.  Hints are advisory and consume
+    # no search RNG: None (the default) is bit-identical to prefetch-off.
+    prefetch: Optional[object] = None
+    # how many speculative child completions to hint per iteration
+    prefetch_rollouts: int = 2
 
     def to_json(self) -> dict:
         return {
@@ -173,6 +182,53 @@ def _materialize_seed(root: Node, path) -> tuple:
     return node, st
 
 
+def _seed_orders(graph: Graph, seeds, limit: int) -> list:
+    """The terminal schedules of the first ``limit`` seed paths — known
+    before the first iteration, so their compiles can prefetch while the
+    incumbent measurements run.  Pure replay on fresh States (the same
+    ``st.apply`` walk ``_materialize_seed`` performs): no tree, no RNG.
+    ``limit`` (the prefetcher's queue bound) caps the replay work: hints
+    beyond the queue would be dropped anyway, so materializing them is
+    O(path_len) State.apply calls for nothing."""
+    orders = []
+    for path in seeds:
+        if len(orders) >= limit:
+            break
+        st = State(graph)
+        for d in path:
+            st = st.apply(d)
+        if st.is_terminal():
+            orders.append(st.sequence)
+    return orders
+
+
+def _speculative_completions(node: Node, platform, prng, k: int,
+                             skip: Optional[Node] = None) -> list:
+    """Up to ``k`` plausible future rollouts for the compile prefetcher:
+    complete the unplayed children of the just-expanded node to terminal
+    schedules on THROWAWAY States with a forked RNG.
+
+    Strictly side-effect-free with respect to the search: the tree is never
+    touched (no ensure_children, no node creation), the search RNG is never
+    consumed, and the (possibly stateful — bench.py's phase_policy carries a
+    lane round-robin) rollout policy is never called — uniform-random
+    completion only.  Misses are the prefetcher's ``wasted`` counter's job
+    to account, not a correctness concern."""
+    hints = []
+    kids = [c for c in node.children
+            if c.n_ == 0 and c is not skip] or [node]
+    for child in kids[:k]:
+        st = child.state
+        while not st.is_terminal():
+            ds = st.get_decisions(platform)
+            if not ds:
+                break
+            st = st.apply(prng.choice(ds))
+        if st.is_terminal():
+            hints.append(remove_redundant_syncs(st.sequence))
+    return hints
+
+
 def explore(
     graph: Graph,
     platform,
@@ -236,6 +292,11 @@ def explore(
         if root is not None:
             ctx.root = root
         seed_iter = iter(seeds if seeds is not None else ())
+        if opts.prefetch is not None and cp.rank() == 0 and seeds:
+            # the seed queue's terminal schedules are known now; compile
+            # them in the background while the first iterations measure
+            opts.prefetch.prefetch(_seed_orders(
+                graph, seeds, getattr(opts.prefetch, "depth", 8)))
         failed_keys: set = set()  # negative cache for uncompilable schedules
         for it in range(opts.n_iters):
             # per-iteration span (ISSUE 1): which node/path was selected,
@@ -280,6 +341,17 @@ def explore(
                             )
                         with counters.phase("REDUNDANT_SYNC"):
                             order = remove_redundant_syncs(order)
+                        if opts.prefetch is not None:
+                            # expansion-children lookahead: speculative
+                            # completions of the leaf's other unplayed
+                            # children compile in the background while this
+                            # rollout measures (forked RNG, throwaway
+                            # States — the search itself is untouched)
+                            opts.prefetch.prefetch(_speculative_completions(
+                                leaf, platform,
+                                _random.Random(
+                                    f"prefetch:{opts.seed}:{it}"),
+                                opts.prefetch_rollouts, skip=child))
                         if tr.enabled and child.decision is not None:
                             it_sp.set("selected", child.decision.desc())
                 # stop-flag + schedule broadcast (mcts.hpp:129-152,244)
@@ -402,6 +474,12 @@ def explore(
                     finals.append(s.order)
                     if len(finals) >= opts.confirm_topk:
                         break
+                if opts.prefetch is not None:
+                    # confirm-queue lookahead: finalists usually hit the
+                    # program cache (they were measured during the search),
+                    # but a resumed run's journal-answered rollouts never
+                    # compiled — prefetch covers exactly that gap
+                    opts.prefetch.prefetch(finals)
             with counters.phase("BCAST"):
                 n_finals = cp.bcast_json(
                     len(finals) if cp.rank() == 0 else None)
