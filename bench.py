@@ -115,6 +115,28 @@ def metric_for(workload: str, args) -> str:
     return f"attn_blockwise_pct50_searched_n{n_ctx}"
 
 
+def workload_cost(workload: str, built):
+    """The workload's roofline :class:`~tenzing_tpu.bench.roofline.Cost`
+    for the attribution profiler's fraction-of-peak join (``built`` is the
+    matching ``build_*`` return).  One iteration's arithmetic + traffic —
+    the same accounting experiments/halo_roofline.py reports against."""
+    from tenzing_tpu.bench import roofline
+
+    if workload == "halo":
+        h = built[3]
+        return roofline.halo_cost(h.nq, h.lx, h.ly, h.lz, h.radius)
+    if workload == "spmv":
+        m = built[3]
+        return roofline.spmv_cost(m, nnz=10 * m)
+    if workload == "moe":
+        margs = built[3][0]
+        return roofline.moe_cost(margs.tokens, margs.d_model, margs.d_ff,
+                                 staged=True, n_experts=margs.n_experts)
+    a = built[3]  # attn
+    return roofline.attention_cost(a.batch, a.n_devices * a.seq_local,
+                                   a.head_dim)
+
+
 def build_halo(args):
     from tenzing_tpu.models.halo import HaloArgs
     from tenzing_tpu.models.halo_pipeline import (
@@ -164,7 +186,7 @@ def build_spmv(args):
     g = Graph()
     g.start_then(mk())
     g.then_finish(mk())
-    return g, jbufs, metric_for("spmv", args)
+    return g, jbufs, metric_for("spmv", args), m
 
 
 def build_moe(args):
@@ -214,7 +236,7 @@ def build_attn(args):
     op = BlockedAttention(aargs, impl_choice=True, fused_choice=True)
     g.start_then(op)
     g.then_finish(op)
-    return g, bufs, metric_for("attn", args)
+    return g, bufs, metric_for("attn", args), aargs
 
 
 def main() -> int:
@@ -309,6 +331,20 @@ def main() -> int:
     ap.add_argument("--inject-hang-secs", type=float, default=60.0,
                     help="how long an injected hang stalls (pair with "
                          "--measure-timeout to exercise the watchdog)")
+    ap.add_argument("--profile-winner", action="store_true",
+                    help="attribution profiling of the final incumbent "
+                         "(docs/observability.md, 'Attribution'): per-op "
+                         "stepped timing of the winner (and naive, for the "
+                         "decision diff), critical path / overlap "
+                         "efficiency / dispatch overhead, stamped as an "
+                         "``attrib`` block in the driver JSON; with "
+                         "--trace-out also writes explain.json and "
+                         "per-lane Gantt tracks into the Perfetto trace")
+    ap.add_argument("--profile-repeats", type=int, default=7,
+                    metavar="N",
+                    help="timed repeats per op in --profile-winner "
+                         "stepped profiling (median minus calibrated "
+                         "fetch overhead)")
     ap.add_argument("--no-verify", action="store_true",
                     help="disable the independent schedule-soundness "
                          "verifier (docs/robustness.md): the guard in the "
@@ -340,6 +376,10 @@ def main() -> int:
         obs.configure(enabled=True)
 
     _telemetry_done = {"v": False}
+    # per-lane Gantt tracks from --profile-winner (chrome trace-event
+    # dicts, obs/attrib/explain.py): filled late in the run, exported by
+    # write_telemetry into the same Perfetto bundle as the PR-1 spans
+    attrib_extra: list = []
 
     def write_telemetry():
         """Archive the telemetry bundle once.  Registered with atexit (for
@@ -364,7 +404,8 @@ def main() -> int:
                             os.path.join(args.trace_out, f"trace{sfx}.jsonl"))
             obs.write_chrome_trace(
                 obs.get_tracer(),
-                os.path.join(args.trace_out, f"trace{sfx}.json"))
+                os.path.join(args.trace_out, f"trace{sfx}.json"),
+                extra_events=attrib_extra or None)
             sys.stderr.write(f"trace bundle: {args.trace_out}\n")
         if args.metrics_json:
             # block=False: this runs from the signal trap, where the
@@ -1419,6 +1460,88 @@ def main() -> int:
         # NOT verified (and already demoted to the pre-loss naive number)
         integrity = {"verified": False, "error": "degraded: no device"}
 
+    # attribution profiling (docs/observability.md, "Attribution"): per-op
+    # stepped timing of the schedule whose number the JSON reports, plus
+    # naive for the decision diff — the attrib block is the measurement
+    # substrate the mega-kernel and chunking work will be judged with
+    # (dispatch overhead removed, which ops fail to overlap).
+    attrib_block = None
+    if args.profile_winner and resilient.degraded:
+        sys.stderr.write("profile-winner: skipped (device lost — no "
+                         "hardware to step ops on)\n")
+    elif args.profile_winner:
+        import os as _os
+
+        t0 = time.time()
+        try:
+            from tenzing_tpu.obs import attrib as _attrib
+
+            winner_seq_p = (top[best_i].order if top and finals and vs > 1.0
+                            else naive_seq)
+            cost = workload_cost(args.workload, built)
+            naive_meas_us = (finals[0].pct50 if finals else naive.pct50) * 1e6
+            w_tl = _attrib.stepped_timeline(ex, winner_seq_p,
+                                            repeats=args.profile_repeats)
+            w_at = _attrib.analyze(winner_seq_p.vector(), w_tl,
+                                   measured_us=value_us, cost=cost)
+            attrib_block = w_at.to_json()
+            expl = None
+            if winner_seq_p is not naive_seq:
+                n_tl = _attrib.stepped_timeline(ex, naive_seq,
+                                                repeats=args.profile_repeats)
+                n_at = _attrib.analyze(naive_seq.vector(), n_tl,
+                                       measured_us=naive_meas_us, cost=cost)
+                expl = _attrib.explain(naive_seq.vector(),
+                                       winner_seq_p.vector(),
+                                       naive_attrib=n_at,
+                                       winner_attrib=w_at)
+                attrib_block["explain"] = expl.get("timing", {})
+            # the winner's raw measurement series rides along for the
+            # report CLI's noise-aware regression check (obs/report.py)
+            fin_res = (finals[1 + best_i] if top and finals and vs > 1.0
+                       else (finals[0] if finals else naive))
+            if fin_res.times:
+                attrib_block["measured_times"] = [
+                    round(t, 9) for t in fin_res.times]
+            if args.trace_out:
+                _os.makedirs(args.trace_out, exist_ok=True)
+                doc = dict(expl) if expl is not None else {}
+                doc["attrib"] = attrib_block
+                _attrib.write_explain(
+                    _os.path.join(args.trace_out, "explain.json"), doc)
+                rank = obs.get_tracer().rank
+                # anchor the Gantt at the current unix-us instant so the
+                # per-lane tracks render next to the span timeline (span
+                # timestamps are unix-anchored, obs/tracer.py)
+                t0_us = time.time() * 1e6
+                attrib_extra.extend(_attrib.timeline_trace_events(
+                    w_at, pid=rank, t0_us=t0_us, label="attrib/winner"))
+                if expl is not None:
+                    attrib_extra.extend(_attrib.timeline_trace_events(
+                        n_at, pid=rank, t0_us=t0_us, label="attrib/naive",
+                        tid_base=2000))
+                sys.stderr.write(
+                    f"explain: {_os.path.join(args.trace_out, 'explain.json')}\n")
+            eff = attrib_block.get("overlap_efficiency")
+            sys.stderr.write(
+                "profile-winner: %d ops stepped, sum-of-parts %.1fus, "
+                "critical path %.1fus, dispatch overhead %.1fus, overlap "
+                "efficiency %s (wall %.0fs)\n"
+                % (attrib_block["n_timed"],
+                   attrib_block["sum_of_parts_us"],
+                   attrib_block["critical_path_us"],
+                   attrib_block["dispatch_overhead_us"],
+                   f"{eff:.3f}" if eff is not None else "n/a",
+                   time.time() - t0))
+        except Exception as e:
+            # profiling is observability, never a verdict gate: a stepped
+            # program that cannot compile (or a mesh platform) degrades to
+            # an error-carrying block instead of killing a finished search
+            sys.stderr.write(
+                f"profile-winner failed ({type(e).__name__}: "
+                f"{str(e)[:200]})\n")
+            attrib_block = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
     if args.dump_csv:
         # One row per distinct schedule.  The decorrelated final-batch results
         # *supersede* the search-time measurements for naive and the finalists
@@ -1509,6 +1632,11 @@ def main() -> int:
                          if top and finals and vs > 1.0 else None),
         "recorded_seeds": len(recorded),
     }
+    # attribution provenance (ISSUE 6): per-op timeline, critical path,
+    # dispatch overhead and overlap efficiency of the reported schedule —
+    # next to the fault/perf blocks, parsed by the report CLI
+    if attrib_block is not None:
+        meta["attrib"] = attrib_block
     # fault-layer provenance (ISSUE 3): a degraded verdict or a quarantine
     # -heavy run must be visible in the parsed metric series, not only in
     # stderr.  ``resumed`` distinguishes a continued run's numbers (its

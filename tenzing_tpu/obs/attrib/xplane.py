@@ -1,0 +1,130 @@
+"""Device-side xplane profiling: jax.profiler traces + transfer/compute
+concurrency analysis — the attribution profiler's fallback timing source.
+
+The primary timing source for attribution is the per-op stepped mode
+(:mod:`tenzing_tpu.obs.attrib.timeline` over ``TraceExecutor.op_stepped``),
+which is single-chip only.  This module is the complement that works on any
+platform the profiler can attach to: capture an ``xplane`` trace of a
+schedule running under the executor, and parse it programmatically to
+measure how much wall time has a transfer (DMA/copy) event concurrent with
+device compute — the quantity a searched overlap schedule exists to create.
+
+History: this code began life as ``utils/profiling.py`` (SURVEY.md §5 maps
+the reference's host-side phase counters — its ``counters.hpp``, whose
+in-repo analog is the ``utils/counters.py`` shim over ``obs/metrics`` — to
+JAX profiler traces on TPU).  ``utils/profiling.py`` is now a deprecation
+shim re-exporting this module.  The archived on-TPU evidence lives in
+``experiments/PROFILE_OVERLAP.json`` (driver:
+``experiments/profile_overlap.py``, which also documents the naive-vs-
+overlap halo comparison) and ``experiments/PROFILE_WINNER.json``
+(``experiments/profile_winner.py``, the winner's per-op-name breakdown).
+
+The analysis is keyword-based over the device planes' event names: transfer
+events (copy/dma/transfer/send/recv/infeed/outfeed) vs compute events
+(fusion/slice/convert/...), with outer control events (while/loop) excluded —
+they span the whole program and would make every DMA look concurrent.
+Intervals are coalesced before intersection so each nanosecond counts once.
+"""
+
+from __future__ import annotations
+
+import glob
+from pathlib import Path
+from typing import Dict, List, Sequence as Seq, Tuple
+
+TRANSFER_KEYWORDS = ("copy", "dma", "transfer", "infeed", "outfeed", "send",
+                     "recv", "all-reduce", "reduce-scatter", "all-gather",
+                     "all-to-all", "collective", "permute", "rdma")
+COMPUTE_KEYWORDS = ("fusion", "dynamic", "slice", "pad", "convert", "reshape",
+                    "add", "concatenate", "custom-call", "custom_call", "dot",
+                    "matmul", "gelu", "broadcast", "select", "iota",
+                    "transpose", "mosaic")
+# outer control events span the whole program and would make every DMA look
+# concurrent — they are neither transfer nor compute nor "unclassified"
+CONTROL_KEYWORDS = ("while", "loop", "condition", "body", "call", "region")
+
+
+def capture_trace(executor, order, out_dir, iters: int = 3) -> Tuple[Path, float]:
+    """Run ``order`` ``iters`` times under ``jax.profiler.trace`` and return
+    (trace directory, wall seconds).  The schedule is compiled and warmed
+    first so the trace holds steady-state execution, not compilation."""
+    import time
+
+    import jax
+
+    run_n = executor.prepare_n(order)
+    run_n(1)  # compile + warm outside the trace
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(str(out_dir)):
+        run_n(iters)
+    return out_dir, time.perf_counter() - t0
+
+
+def merge_intervals(ivs: Seq[Tuple[int, int]]) -> List[List[int]]:
+    """Coalesce intervals so busy time and intersections count each
+    nanosecond once."""
+    out: List[List[int]] = []
+    for a, b in sorted(ivs):
+        if out and a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return out
+
+
+def analyze_trace(trace_dir) -> Dict[str, float]:
+    """Transfer-vs-compute concurrency on the device planes of the newest
+    xplane file under ``trace_dir`` (see module docstring for the method)."""
+    from jax.profiler import ProfileData
+
+    paths = sorted(glob.glob(str(Path(trace_dir) / "**" / "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        return {"error": f"no xplane under {trace_dir}"}
+    data = ProfileData.from_file(paths[-1])
+    xfers: List[Tuple[int, int]] = []
+    computes: List[Tuple[int, int]] = []
+    unclassified: List[Tuple[int, int]] = []
+    for plane in data.planes:
+        pname = plane.name.lower()
+        if not ("tpu" in pname or "device" in pname or "xla" in pname):
+            continue
+        for line in plane.lines:
+            for ev in line.events:
+                nm = (ev.name or "").lower()
+                iv = (ev.start_ns, ev.end_ns)
+                if iv[1] <= iv[0]:
+                    continue
+                if any(k in nm for k in TRANSFER_KEYWORDS):
+                    xfers.append(iv)
+                elif any(k in nm for k in COMPUTE_KEYWORDS):
+                    computes.append(iv)
+                elif not any(k in nm for k in CONTROL_KEYWORDS):
+                    # neither transfer, compute, nor outer control: report it
+                    # so silent misclassification is visible (ADVICE r3)
+                    unclassified.append(iv)
+
+    def total(ivs):
+        return sum(b - a for a, b in merge_intervals(ivs))
+
+    overlap_ns = 0
+    computes_merged = merge_intervals(computes)
+    for a, b in merge_intervals(xfers):
+        for c, d in computes_merged:
+            if c >= b:
+                break
+            lo, hi = max(a, c), min(b, d)
+            if hi > lo:
+                overlap_ns += hi - lo
+    return {
+        "xplane": paths[-1],
+        "n_transfer_events": len(xfers),
+        "n_compute_events": len(computes),
+        "n_unclassified_events": len(unclassified),
+        "transfer_busy_ms": total(xfers) / 1e6,
+        "compute_busy_ms": total(computes) / 1e6,
+        "unclassified_busy_ms": total(unclassified) / 1e6,
+        "transfer_concurrent_with_compute_ms": overlap_ns / 1e6,
+    }

@@ -76,13 +76,38 @@ def _category(name: str) -> str:
     return name.split(".", 1)[0]
 
 
-def chrome_trace(tracer: Tracer) -> Dict[str, Any]:
-    """The trace as a Chrome trace-event object (see module docstring)."""
+def _track_name(tid: int, lanes: Dict[int, int]) -> str:
+    """Human name of a thread track: spans that carried a ``lane``
+    attribute name their track after the lane; plain threads keep a
+    ``thread N`` label (tid 0 — the main thread everywhere in this
+    codebase — reads as ``main``)."""
+    if tid in lanes:
+        return f"lane {lanes[tid]}"
+    return "main" if tid == 0 else f"thread {tid}"
+
+
+def chrome_trace(tracer: Tracer,
+                 extra_events: List[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The trace as a Chrome trace-event object (see module docstring).
+
+    Tracks are NAMED (``thread_name`` metadata per (pid, tid), on top of
+    the per-rank ``process_name`` rows): a span whose attrs carry a
+    ``lane`` names its track ``lane N``, so attribution timelines
+    (obs/attrib/explain.py ``timeline_trace_events`` — passed in via
+    ``extra_events``, which may carry their own ``M`` metadata) and the
+    ordinary spans render as one grouped per-rank trace instead of flat
+    anonymous thread rows."""
     trace_events: List[Dict[str, Any]] = []
     pids = set()
+    tids = set()  # (pid, tid) pairs needing a thread_name row
+    lane_of: Dict[int, int] = {}  # tid -> lane id, when a span declares one
     spans, events = _snapshot(tracer)
     for sp in spans:
         pids.add(sp.pid)
+        tids.add((sp.pid, sp.tid))
+        lane = sp.attrs.get("lane")
+        if isinstance(lane, int):
+            lane_of[sp.tid] = lane
         trace_events.append({
             "name": sp.name,
             "cat": _category(sp.name),
@@ -95,6 +120,7 @@ def chrome_trace(tracer: Tracer) -> Dict[str, Any]:
         })
     for ev in events:
         pids.add(ev.pid)
+        tids.add((ev.pid, ev.tid))
         trace_events.append({
             "name": ev.name,
             "cat": _category(ev.name),
@@ -105,15 +131,33 @@ def chrome_trace(tracer: Tracer) -> Dict[str, Any]:
             "s": "t",  # thread-scoped instant
             "args": ev.attrs,
         })
+    extra_meta: List[Dict[str, Any]] = []
+    for e in extra_events or []:
+        if e.get("ph") == "M":
+            extra_meta.append(e)
+            continue
+        pids.add(e.get("pid", 0))
+        trace_events.append(e)
     trace_events.sort(key=lambda e: e["ts"])
     meta = [
         {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
          "args": {"name": f"rank {pid}"}}
         for pid in sorted(pids)
     ]
+    meta += [
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+         "args": {"name": _track_name(tid, lane_of)}}
+        for pid, tid in sorted(tids)
+    ]
+    meta += extra_meta
     return {"traceEvents": meta + trace_events, "displayTimeUnit": "ms"}
 
 
-def write_chrome_trace(tracer: Tracer, path: str) -> None:
+def write_chrome_trace(tracer: Tracer, path: str,
+                       extra_events: List[Dict[str, Any]] = None) -> None:
+    """Write the Perfetto-loadable trace; ``extra_events`` appends
+    pre-built trace-event dicts (e.g. the attribution profiler's per-lane
+    Gantt tracks) into the same bundle."""
     with open(path, "w") as f:
-        json.dump(chrome_trace(tracer), f, default=str)
+        json.dump(chrome_trace(tracer, extra_events=extra_events), f,
+                  default=str)

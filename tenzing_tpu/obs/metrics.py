@@ -65,8 +65,10 @@ class Histogram:
     Aggregates (count/sum/min/max) are exact and O(1) per observation; raw
     values are retained only up to ``max_raw`` for the percentile summary —
     a hot loop observing per-node timings (DFS enumeration) cannot grow
-    memory without bound.  A truncated summary carries ``raw_retained`` so
-    downstream tooling knows the percentiles cover a prefix."""
+    memory without bound.  A truncated summary carries ``raw_retained`` and
+    ``truncated: true`` so downstream tooling (e.g. the report CLI,
+    obs/report.py) labels the percentiles prefix-only instead of silently
+    treating them as full-series statistics."""
 
     __slots__ = ("name", "_lock", "_values", "_count", "_sum", "_min",
                  "_max", "_max_raw")
@@ -138,7 +140,13 @@ class Histogram:
             "p99": percentile(xs, 99),
         }
         if len(xs) < count:
+            # the retained-raw cap truncated the series: the percentiles
+            # cover only the first ``raw_retained`` of ``count``
+            # observations.  ``truncated`` is the explicit marker readers
+            # (the report CLI labels such percentiles "prefix-only") can
+            # key on without comparing count vs raw_retained themselves.
             out["raw_retained"] = len(xs)
+            out["truncated"] = True
         return out
 
 

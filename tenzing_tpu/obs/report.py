@@ -1,0 +1,468 @@
+"""Corpus analytics + regression gate: ``python -m tenzing_tpu.obs.report``.
+
+Five rounds of searching left a measurement corpus on disk — recorded
+search databases (``experiments/*_search_tpu*.csv``), driver JSON verdicts
+(``BENCH_*.json``), checkpoint journals, quarantines, telemetry bundles.
+This CLI mines them into one markdown report and implements the
+**noise-aware regression check** the CI and future PRs gate on
+(docs/observability.md, "Attribution").
+
+Sections (each optional, driven by which inputs are given):
+
+* ``--csv GLOB``   — recorded-database trajectory per workload: rows,
+  naive anchor, best in-file paired ratio (the same regime-honest ranking
+  bench/recorded.py warm-starts from — numeric parse only, no graph);
+* ``--bench GLOB`` — driver-JSON trajectory: value / vs_baseline /
+  naive regime, plus the fault (quarantine, degradation, verification),
+  perf (compile + prefetch economics) and attrib (overlap efficiency,
+  dispatch overhead) meta blocks;
+* ``--journal DIR``— checkpoint mining: journaled measurements by
+  provenance and fidelity, batch replays, quarantine contents;
+* ``--trace GLOB`` — telemetry-bundle mining: where the wall went (top
+  spans by total duration), event counts;
+* ``--metrics GLOB`` — metrics-JSON histograms; summaries whose raw
+  series was truncated (``truncated: true`` — obs/metrics.py) are labeled
+  **prefix-only** rather than passed off as full-series percentiles.
+
+Regression check (``--check FRESH --baseline BASELINE [--tol T]``):
+compares two driver JSONs (raw driver lines or the ``{"parsed": ...}``
+BENCH wrapper).  The primary series is ``vs_baseline`` (the paired
+speedup — regime-immune by construction); the secondary is the
+naive-relative value (``value / naive_us``).  Noise-awareness reuses
+bench/randomness.py's runs test: when the fresh JSON's attrib block
+carries the winner's raw measurement series and that series fails the
+i.i.d. test, a would-be regression is reported ``inconclusive`` (drift or
+interference — re-measure) instead of flagged.  Exit status: 0 ok /
+inconclusive, 1 regression, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+# -- driver-JSON loading ----------------------------------------------------
+
+def load_driver_json(path: str) -> Dict[str, Any]:
+    """A driver verdict dict from ``path``: accepts a raw driver JSON
+    object/line, a file whose LAST line is the driver JSON (bench.py
+    stdout capture), or the repo's ``BENCH_*.json`` wrapper (uses its
+    ``parsed`` field)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            if "parsed" in doc and isinstance(doc["parsed"], dict):
+                return doc["parsed"]
+            if "metric" in doc:
+                return doc
+    except ValueError:
+        pass
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "metric" in doc:
+            return doc
+    raise ValueError(f"{path}: no driver JSON found")
+
+
+# -- regression check -------------------------------------------------------
+
+def check_regression(fresh: Dict[str, Any], baseline: Dict[str, Any],
+                     tol: float = 0.05) -> Dict[str, Any]:
+    """Noise-aware comparison of a fresh driver verdict against a
+    committed baseline (see module docstring).  Returns ``{"verdict":
+    "ok"|"regression"|"inconclusive", "reasons": [...], ...}``."""
+    reasons: List[str] = []
+    checks: Dict[str, Any] = {}
+
+    f_vs, b_vs = fresh.get("vs_baseline"), baseline.get("vs_baseline")
+    if f_vs is not None and b_vs is not None and b_vs > 0:
+        floor = b_vs * (1.0 - tol)
+        checks["vs_baseline"] = {"fresh": f_vs, "baseline": b_vs,
+                                 "floor": round(floor, 4)}
+        if f_vs < floor:
+            reasons.append(
+                f"vs_baseline {f_vs:.4f} < {floor:.4f} "
+                f"(baseline {b_vs:.4f} - {tol:.0%})")
+
+    # naive-relative value: value/naive_us is regime-honest where raw value
+    # is not (chip regimes swing >1.3x run to run — bench/recorded.py)
+    def rel(d):
+        v, n = d.get("value"), d.get("naive_us")
+        return (v / n) if v and n else None
+
+    f_rel, b_rel = rel(fresh), rel(baseline)
+    if f_rel is not None and b_rel is not None and b_rel > 0:
+        ceil = b_rel * (1.0 + tol)
+        checks["relative_value"] = {"fresh": round(f_rel, 4),
+                                    "baseline": round(b_rel, 4),
+                                    "ceiling": round(ceil, 4)}
+        if f_rel > ceil:
+            reasons.append(
+                f"value/naive {f_rel:.4f} > {ceil:.4f} "
+                f"(baseline {b_rel:.4f} + {tol:.0%})")
+
+    verdict = "regression" if reasons else "ok"
+    times = (fresh.get("attrib") or {}).get("measured_times")
+    if reasons and times and len(times) >= 8:
+        from tenzing_tpu.bench.randomness import runs_test_z
+
+        z_crit = 1.96  # is_random's 95%-confidence default
+        z = runs_test_z(times)
+        checks["runs_test_z"] = round(z, 3)
+        if abs(z) > z_crit:
+            # the fresh series shows non-random structure (drift /
+            # interference): the measurement, not the schedule, is suspect
+            verdict = "inconclusive"
+            reasons.append(
+                f"fresh measurement series fails the runs test "
+                f"(|Z|={abs(z):.2f} > {z_crit}) — re-measure before "
+                "trusting the regression")
+    return {"verdict": verdict, "tol": tol, "reasons": reasons,
+            "checks": checks}
+
+
+# -- recorded-database mining (numeric parse, no graph) ---------------------
+
+def _csv_rows(path: str) -> List[Tuple[int, float, str]]:
+    """(row idx, pct50, fidelity) per parseable row of a recorded DB."""
+    from tenzing_tpu.bench.benchmarker import CSV_DELIM, split_fidelity
+
+    out = []
+    with open(path) as f:
+        for line in f:
+            cells = line.rstrip("\n").split(CSV_DELIM)
+            try:
+                idx = int(cells[0])
+                pct50 = float(cells[3])
+                fid, _ = split_fidelity(cells)
+            except (ValueError, IndexError):
+                continue
+            out.append((idx, pct50, fid))
+    return out
+
+
+def _workload_of(path: str) -> str:
+    base = os.path.basename(path)
+    return base.split("_")[0] if "_" in base else base
+
+
+def corpus_section(csv_paths: List[str]) -> List[str]:
+    from tenzing_tpu.bench.recorded import naive_anchor_of
+
+    lines = ["## Recorded search databases", "",
+             "| file | workload | rows (full) | naive anchor (us) | "
+             "best in-file ratio | best pct50 (us) |",
+             "|---|---|---|---|---|---|"]
+    best_by_wl: Dict[str, float] = {}
+    for path in csv_paths:
+        try:
+            rows = _csv_rows(path)
+            anchor = naive_anchor_of(path)
+        except OSError as e:
+            lines.append(f"| {os.path.basename(path)} | — | unreadable "
+                         f"({e.__class__.__name__}) | | | |")
+            continue
+        full = [(i, p) for i, p, fid in rows
+                if fid == "full" and i > 0 and p > 0]
+        wl = _workload_of(path)
+        if anchor and full:
+            best_p = min(p for _, p in full)
+            ratio = anchor / best_p
+            best_by_wl[wl] = max(best_by_wl.get(wl, 0.0), ratio)
+            lines.append(
+                f"| {os.path.basename(path)} | {wl} | {len(rows)} "
+                f"({len(full)}) | {anchor * 1e6:.1f} | {ratio:.3f} | "
+                f"{best_p * 1e6:.1f} |")
+        else:
+            lines.append(
+                f"| {os.path.basename(path)} | {wl} | {len(rows)} "
+                f"({len(full)}) | {'—' if not anchor else f'{anchor*1e6:.1f}'}"
+                " | — | — |")
+    if best_by_wl:
+        lines += ["", "Best recorded in-file paired ratio per workload: " +
+                  ", ".join(f"**{wl}** {r:.3f}x"
+                            for wl, r in sorted(best_by_wl.items()))]
+    lines.append("")
+    return lines
+
+
+# -- driver-JSON mining -----------------------------------------------------
+
+def bench_section(paths: List[str]) -> List[str]:
+    lines = ["## Driver verdicts", "",
+             "| file | metric | value (us) | vs_baseline | naive (us) | "
+             "compile (s) | prefetch hit/issued | quarantined | verified | "
+             "overlap eff | dispatch ovh (us) |",
+             "|---|---|---|---|---|---|---|---|---|---|---|"]
+    for path in paths:
+        try:
+            d = load_driver_json(path)
+        except (OSError, ValueError) as e:
+            lines.append(f"| {os.path.basename(path)} | unreadable "
+                         f"({e.__class__.__name__}) | | | | | | | | | |")
+            continue
+        perf = d.get("perf") or {}
+        pf = perf.get("prefetch") or {}
+        fault = d.get("fault") or {}
+        at = d.get("attrib") or {}
+        ver = fault.get("verified")
+        lines.append(
+            "| {f} | {m} | {v} | {vs} | {n} | {c} | {ph}/{pi} | {q} | {ok} "
+            "| {oe} | {do} |".format(
+                f=os.path.basename(path), m=d.get("metric", "—"),
+                v=d.get("value", "—"), vs=d.get("vs_baseline", "—"),
+                n=d.get("naive_us", "—"),
+                c=perf.get("compile_secs", "—"),
+                ph=pf.get("hits", "—"), pi=pf.get("issued", "—"),
+                q=fault.get("quarantined", 0),
+                ok=("—" if ver is None else ver),
+                oe=at.get("overlap_efficiency", "—"),
+                do=at.get("dispatch_overhead_us", "—")))
+    lines.append("")
+    return lines
+
+
+# -- checkpoint-journal mining ----------------------------------------------
+
+def journal_section(dirs: List[str]) -> List[str]:
+    from tenzing_tpu.utils.numeric import percentile
+
+    lines = ["## Checkpoint journals", ""]
+    for d in dirs:
+        jpath = os.path.join(d, "measurements.jsonl")
+        qpath = os.path.join(d, "quarantine.json")
+        lines.append(f"### `{d}`")
+        if not os.path.exists(jpath):
+            lines += ["", "no measurement journal", ""]
+        else:
+            by_prov: Dict[str, int] = {}
+            pct50s: List[float] = []
+            batches = 0
+            skipped = 0
+            with open(jpath) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        j = json.loads(line)
+                    except ValueError:
+                        skipped += 1  # torn tail line
+                        continue
+                    if "batch" in j:
+                        batches += 1
+                        continue
+                    prov = j.get("prov", "measured")
+                    by_prov[prov] = by_prov.get(prov, 0) + 1
+                    try:
+                        pct50s.append(float(j["result"]["pct50"]))
+                    except (KeyError, TypeError, ValueError):
+                        pass
+            lines.append("")
+            lines.append(f"- measurements: {sum(by_prov.values())} (" +
+                         ", ".join(f"{k}={v}"
+                                   for k, v in sorted(by_prov.items())) +
+                         f"), paired batches: {batches}" +
+                         (f", torn/skipped lines: {skipped}" if skipped
+                          else ""))
+            if pct50s:
+                xs = sorted(pct50s)
+                lines.append(
+                    f"- journaled pct50 (us): min {xs[0]*1e6:.1f} / p50 "
+                    f"{percentile(xs, 50)*1e6:.1f} / max {xs[-1]*1e6:.1f}")
+        if os.path.exists(qpath):
+            try:
+                with open(qpath) as f:
+                    q = json.load(f)
+                entries = q.get("entries", {})
+                by_cls: Dict[str, int] = {}
+                for e in entries.values():
+                    c = e.get("error_class", "?")
+                    by_cls[c] = by_cls.get(c, 0) + 1
+                lines.append(
+                    f"- quarantine: {len(entries)} schedule(s)" +
+                    (" (" + ", ".join(f"{k}={v}"
+                                      for k, v in sorted(by_cls.items())) +
+                     ")" if by_cls else ""))
+            except (OSError, ValueError):
+                lines.append("- quarantine: unreadable")
+        lines.append("")
+    return lines
+
+
+# -- telemetry-bundle mining ------------------------------------------------
+
+def trace_section(paths: List[str], top: int = 12) -> List[str]:
+    from tenzing_tpu.obs.export import read_jsonl
+
+    lines = ["## Telemetry bundles", ""]
+    for path in paths:
+        try:
+            recs = read_jsonl(path)
+        except (OSError, ValueError) as e:
+            lines += [f"### `{path}`", "", f"unreadable ({e})", ""]
+            continue
+        span_tot: Dict[str, float] = {}
+        span_n: Dict[str, int] = {}
+        ev_n: Dict[str, int] = {}
+        for r in recs:
+            if r.get("kind") == "span":
+                nm = r.get("name", "?")
+                span_tot[nm] = span_tot.get(nm, 0.0) + float(
+                    r.get("dur_us", 0.0))
+                span_n[nm] = span_n.get(nm, 0) + 1
+            elif r.get("kind") == "event":
+                nm = r.get("name", "?")
+                ev_n[nm] = ev_n.get(nm, 0) + 1
+        lines += [f"### `{path}`", "",
+                  f"- records: {len(recs)} ({sum(span_n.values())} spans, "
+                  f"{sum(ev_n.values())} events)",
+                  "", "| span | count | total (s) |", "|---|---|---|"]
+        for nm in sorted(span_tot, key=lambda n: -span_tot[n])[:top]:
+            lines.append(f"| {nm} | {span_n[nm]} | "
+                         f"{span_tot[nm] / 1e6:.3f} |")
+        if ev_n:
+            lines += ["", "events: " + ", ".join(
+                f"{nm}={ev_n[nm]}"
+                for nm in sorted(ev_n, key=lambda n: -ev_n[n])[:top])]
+        lines.append("")
+    return lines
+
+
+# -- metrics-JSON mining ----------------------------------------------------
+
+def metrics_section(paths: List[str], top: int = 12) -> List[str]:
+    lines = ["## Metrics", ""]
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            lines += [f"### `{path}`", "", f"unreadable ({e})", ""]
+            continue
+        hists = doc.get("histograms", {})
+        lines += [f"### `{path}`", "",
+                  "| histogram | count | sum | p50 | p99 | coverage |",
+                  "|---|---|---|---|---|---|"]
+        for nm in sorted(hists,
+                         key=lambda n: -hists[n].get("sum", 0.0))[:top]:
+            h = hists[nm]
+            if h.get("truncated") or "raw_retained" in h:
+                # obs/metrics.py Histogram.summary: the raw series was
+                # capped; percentiles cover only the first raw_retained.
+                # Legacy summaries (pre-``truncated`` flag) carried only
+                # raw_retained — label those prefix-only too.
+                cov = (f"prefix-only ({h.get('raw_retained', '?')}/"
+                       f"{h.get('count', '?')})")
+            else:
+                cov = "full"
+            lines.append(
+                f"| {nm} | {h.get('count', 0)} | "
+                f"{h.get('sum', 0.0):.4g} | {h.get('p50', '—')} | "
+                f"{h.get('p99', '—')} | {cov} |")
+        lines.append("")
+    return lines
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _expand(globs: Optional[List[str]]) -> List[str]:
+    out: List[str] = []
+    for pat in globs or []:
+        hits = sorted(_glob.glob(pat))
+        out.extend(hits if hits else ([pat] if os.path.exists(pat) else []))
+    return out
+
+
+def build_report(args) -> Tuple[str, Optional[Dict[str, Any]]]:
+    lines: List[str] = ["# tenzing-tpu corpus report", ""]
+    verdict: Optional[Dict[str, Any]] = None
+    csvs = _expand(args.csv)
+    if csvs:
+        lines += corpus_section(csvs)
+    benches = _expand(args.bench)
+    if benches:
+        lines += bench_section(benches)
+    if args.journal:
+        lines += journal_section(args.journal)
+    traces = _expand(args.trace)
+    if traces:
+        lines += trace_section(traces)
+    metrics = _expand(args.metrics)
+    if metrics:
+        lines += metrics_section(metrics)
+    if args.check:
+        fresh = load_driver_json(args.check)
+        baseline = load_driver_json(args.baseline)
+        verdict = check_regression(fresh, baseline, tol=args.tol)
+        lines += ["## Regression check", "",
+                  f"- fresh: `{args.check}`",
+                  f"- baseline: `{args.baseline}` (tol {args.tol:.0%})",
+                  f"- **verdict: {verdict['verdict']}**"]
+        for r in verdict["reasons"]:
+            lines.append(f"  - {r}")
+        lines += ["", "```json",
+                  json.dumps(verdict["checks"], indent=2, sort_keys=True),
+                  "```", ""]
+    if len(lines) <= 2:
+        lines += ["(no inputs given — see --help)", ""]
+    return "\n".join(lines), verdict
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tenzing_tpu.obs.report",
+        description="Mine the measurement corpus into a markdown report "
+                    "and run the noise-aware regression check "
+                    "(docs/observability.md, 'Attribution').")
+    ap.add_argument("--csv", nargs="*", default=None, metavar="GLOB",
+                    help="recorded search databases (bench.py --dump-csv)")
+    ap.add_argument("--bench", nargs="*", default=None, metavar="GLOB",
+                    help="driver JSON verdicts (raw lines or BENCH_*.json "
+                         "wrappers)")
+    ap.add_argument("--journal", nargs="*", default=None, metavar="DIR",
+                    help="checkpoint directories (bench.py --checkpoint)")
+    ap.add_argument("--trace", nargs="*", default=None, metavar="GLOB",
+                    help="telemetry JSONL bundles (bench.py --trace-out)")
+    ap.add_argument("--metrics", nargs="*", default=None, metavar="GLOB",
+                    help="metrics JSON files (bench.py --metrics-json)")
+    ap.add_argument("--check", default=None, metavar="FRESH",
+                    help="fresh driver JSON for the regression check")
+    ap.add_argument("--baseline", default=None, metavar="BASE",
+                    help="committed baseline driver JSON (e.g. "
+                         "BENCH_r05.json)")
+    ap.add_argument("--tol", type=float, default=0.05,
+                    help="relative regression tolerance (default 0.05)")
+    ap.add_argument("--out", default=None,
+                    help="write the markdown report here (default stdout)")
+    args = ap.parse_args(argv)
+    if bool(args.check) != bool(args.baseline):
+        ap.error("--check and --baseline must be given together")
+    try:
+        report, verdict = build_report(args)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"report: {e}\n")
+        return 2
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        sys.stderr.write(f"report: {args.out}\n")
+    else:
+        sys.stdout.write(report)
+    return 1 if (verdict and verdict["verdict"] == "regression") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -604,6 +604,93 @@ class TraceExecutor:
         self._cache.setdefault(key, compiled)
         return True
 
+    # -- timed execution mode (the attribution profiler's entry point) ------
+    def op_stepped(self, order: Sequence):
+        """Per-op stepped sub-programs — the attribution profiler's timed
+        execution mode (obs/attrib/timeline.py).  Returns ``[(positions,
+        fn)]`` covering every schedule position in order:
+
+        * a sync op gets ``fn=None`` (token bookkeeping has no device work
+          to time; its happens-before role is reconstructed by the analysis
+          layer from the full op list);
+        * every other op gets its own jitted ``fn(bufs) -> (fence, bufs)``
+          sub-program tracing JUST that op against the buffer state the
+          previous steps produced, fenced by a sum over the op's written
+          buffers (full reduction, so the op's outputs stay live — the
+          fence read is part of the step's measured cost and is documented
+          as the stepped-mode bias in docs/observability.md);
+        * split-kernel transfer posts (``rdma_copy_start`` /
+          ``rdma_shift_start``) are grouped with everything through their
+          matching ``await_transfer`` / ``multi_await`` into ONE step: the
+          posted wait closure (``TraceContext.inflight``) cannot cross a
+          jit trace boundary, so post→await is the smallest timeable unit.
+
+        Mesh platforms are rejected: per-op stepping would have to carry
+        shard-varying token state across program boundaries; multi-chip
+        attribution goes through the xplane path (obs/attrib/xplane.py).
+        """
+        if self.platform.mesh is not None:
+            raise RuntimeError(
+                "op_stepped: per-op stepped profiling is single-chip only "
+                "(use obs/attrib/xplane.py jax.profiler capture on meshes)")
+        ops = order.vector()
+        steps = []
+        cur: List[int] = []
+        pending: set = set()
+        for p, op in enumerate(ops):
+            if getattr(op, "is_sync", lambda: False)():
+                if cur:
+                    cur.append(p)  # keep position; trace skips it
+                else:
+                    steps.append(((p,), None))
+                continue
+            cur.append(p)
+            kind = getattr(op, "KIND", "")
+            if kind in ("rdma_copy_start", "rdma_shift_start"):
+                pending.update(op.writes())
+            elif kind == "await_transfer":
+                pending.discard(op.buf())
+            elif kind == "multi_await":
+                pending.difference_update(op.bufs())
+            if not pending:
+                steps.append((tuple(cur), self._op_step_fn(ops, tuple(cur))))
+                cur = []
+        if cur:  # un-awaited tail: still timeable as one group
+            steps.append((tuple(cur), self._op_step_fn(ops, tuple(cur))))
+        return steps
+
+    def _op_step_fn(self, ops: List[OpBase], positions) -> Callable:
+        """The jitted sub-program for one stepped group: trace the group's
+        non-sync ops with a fresh TraceContext (steps run to completion
+        before the next starts, so zero token seeds are exact) and fence on
+        a full reduction of the group's written device-space buffers."""
+        group = [ops[p] for p in positions
+                 if not getattr(ops[p], "is_sync", lambda: False)()]
+        host_space0 = self._host_space_after(ops[: positions[0]])
+        host_space_after = self._host_space_after(ops[: positions[-1] + 1])
+        axis_names = self.platform.axis_names
+        written = [n for op in group
+                   for n in (op.writes() if hasattr(op, "writes") else [])]
+        fence_names = [n for n in dict.fromkeys(written)
+                       if n not in host_space_after]
+
+        def fn(bufs: Dict[str, Any]) -> Any:
+            tc = TraceContext(dict(bufs), axis_names=axis_names,
+                              host_space=set(host_space0))
+            for op in group:
+                op.trace(tc)
+            _check_inflight_drained(tc)
+            fence = jnp.zeros((), jnp.float32)
+            for name in fence_names:
+                for leaf in jax.tree_util.tree_leaves(tc.bufs[name]):
+                    x = jnp.asarray(leaf)
+                    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+                        x = jnp.real(x)
+                    fence = fence + jnp.sum(x).astype(jnp.float32)
+            return fence, tc.bufs
+
+        return jax.jit(fn)
+
     def lowered_text(self, order: Sequence) -> str:
         """Lowered (pre-optimization) HLO of a schedule (debugging / tests)."""
         return jax.jit(self._build(order)).lower(self.init_bufs).as_text()

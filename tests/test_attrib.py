@@ -1,0 +1,448 @@
+"""Schedule attribution profiler (ISSUE 6): timeline analysis against
+hand-computed critical paths / overlap efficiencies / dispatch overheads
+(pure CPU, synthetic durations), the stepped timing mode on a real
+executor, the winner-vs-naive decision diff (golden facts on the recorded
+halo corpus), the per-lane Perfetto emission, and the report CLI's
+noise-aware regression check (must flag a synthetic slowdown, pass the
+unmodified committed baseline, and downgrade drift-contaminated series to
+inconclusive)."""
+
+import json
+import os
+
+import pytest
+
+from tenzing_tpu.core.operation import DeviceOp
+from tenzing_tpu.core.resources import Event, Lane
+from tenzing_tpu.core.sync_ops import EventRecord, EventSync, WaitEvent
+from tenzing_tpu.obs.attrib import (
+    OpRecord,
+    OpTimeline,
+    analyze,
+    diff_schedules,
+    explain,
+    stepped_timeline,
+    timeline_trace_events,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TOp(DeviceOp):
+    """Minimal device op for synthetic schedules (no buffers needed —
+    the analysis layer only consumes op kinds/lanes/names)."""
+
+    def apply(self, bufs, ctx):
+        return {}
+
+
+def _timeline(ops, durs):
+    """An OpTimeline with the given per-position durations (µs)."""
+    recs = []
+    for p, op in enumerate(ops):
+        if getattr(op, "is_sync", lambda: False)():
+            lanes = op.lanes() if hasattr(op, "lanes") else []
+            recs.append(OpRecord(name=op.desc(), desc=op.desc(),
+                                 kind="sync",
+                                 lane=(lanes[0].id if lanes else None),
+                                 positions=(p,)))
+        else:
+            recs.append(OpRecord(name=op.name(), desc=op.desc(),
+                                 kind="device", lane=op.lane().id,
+                                 positions=(p,), dur_us=durs.get(p, 0.0)))
+    return OpTimeline(records=recs, schedule="t", source="synthetic",
+                      n_ops=len(ops))
+
+
+L0, L1 = Lane(0), Lane(1)
+
+
+# -- analysis: hand-computed critical paths / efficiencies ------------------
+
+def test_serial_same_lane_critical_path_is_sum():
+    ops = [TOp("a").bind(L0), TOp("b").bind(L0)]
+    at = analyze(ops, _timeline(ops, {0: 10.0, 1: 20.0}), measured_us=25.0)
+    assert at.sum_of_parts_us == 30.0
+    assert at.critical_path_us == 30.0
+    assert at.critical_path == ["a", "b"]
+    # measured (25) beats the stepped sum (30): the 5us gap is dispatch
+    # overhead the fused program does not pay
+    assert at.dispatch_overhead_us == 5.0
+    # measured < HB bound -> the schedule achieved every permitted overlap
+    assert at.overlap_efficiency == 1.0
+
+
+def test_independent_lanes_overlap_and_gantt_starts():
+    ops = [TOp("a").bind(L0), TOp("b").bind(L1)]
+    at = analyze(ops, _timeline(ops, {0: 10.0, 1: 20.0}), measured_us=22.0)
+    # no sync, no host op between them: the lanes are concurrent
+    assert at.critical_path_us == 20.0
+    assert at.critical_path == ["b"]
+    assert at.timeline.records[0].start_us == 0.0
+    assert at.timeline.records[1].start_us == 0.0
+    assert at.overlap_efficiency == pytest.approx(20.0 / 22.0)
+    assert at.dispatch_overhead_us == pytest.approx(8.0)
+    assert at.per_lane_busy_us == {"lane 0": 10.0, "lane 1": 20.0}
+
+
+def test_cross_lane_sync_serializes_the_gantt():
+    e0 = Event(0)
+    ops = [TOp("a").bind(L0), EventRecord(L0, e0), WaitEvent(L1, e0),
+           TOp("b").bind(L1)]
+    at = analyze(ops, _timeline(ops, {0: 10.0, 3: 20.0}), measured_us=30.0)
+    # record/wait joins lane1 behind a: b starts at a's end
+    assert at.timeline.records[3].start_us == 10.0
+    assert at.critical_path_us == 30.0
+    assert at.critical_path == ["a", "b"]  # syncs route but don't appear
+    assert at.overlap_efficiency == 1.0
+    assert at.dispatch_overhead_us == 0.0
+
+
+def test_host_dispatch_orders_after_host_chain():
+    # a device op joins the host chain at dispatch: an EventSync (host op)
+    # between two device ops on DIFFERENT lanes still serializes them
+    e0 = Event(0)
+    ops = [TOp("a").bind(L0), EventRecord(L0, e0), EventSync(e0),
+           TOp("b").bind(L1)]
+    at = analyze(ops, _timeline(ops, {0: 10.0, 3: 20.0}))
+    assert at.timeline.records[3].start_us == 10.0
+    assert at.critical_path_us == 30.0
+
+
+def test_efficiency_bounds_and_roofline_join():
+    from tenzing_tpu.bench.roofline import Cost
+
+    ops = [TOp("a").bind(L0), TOp("b").bind(L1)]
+    tl = _timeline(ops, {0: 10.0, 1: 10.0})
+    # measured slower than every bound: efficiency in (0, 1], overhead >= 0
+    at = analyze(ops, tl, measured_us=100.0,
+                 cost=Cost(flops=1e6, hbm_bytes=1e3))
+    assert 0.0 < at.overlap_efficiency <= 1.0
+    assert at.overlap_efficiency == pytest.approx(0.1)
+    assert at.dispatch_overhead_us == 0.0  # clamped: measured > sum
+    assert at.utilization is not None and at.utilization["tflops"] > 0
+    # per-op costs join per unit
+    at2 = analyze(ops, _timeline(ops, {0: 10.0, 1: 10.0}), measured_us=20.0,
+                  per_op_costs={"a": Cost(flops=2e6, hbm_bytes=0.0)})
+    assert "a" in at2.per_op_utilization
+    assert at2.per_op_utilization["a"]["tflops"] == pytest.approx(
+        2e6 / (10e-6) / 1e12)
+
+
+def test_timeline_json_roundtrip():
+    ops = [TOp("a").bind(L0), TOp("b").bind(L1)]
+    at = analyze(ops, _timeline(ops, {0: 10.0, 1: 20.0}), measured_us=22.0)
+    back = OpTimeline.from_json(json.loads(json.dumps(at.timeline.to_json())))
+    assert [r.name for r in back.records] == ["a", "b"]
+    assert back.records[1].dur_us == 20.0
+    doc = at.to_json()
+    assert doc["n_timed"] == 2 and len(doc["timeline"]) == 2
+
+
+# -- stepped timing on a real executor (CPU) --------------------------------
+
+@pytest.fixture(scope="module")
+def stepped():
+    import jax.numpy as jnp
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import State
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    class Mul(DeviceOp):
+        def __init__(self, name, src, dst):
+            super().__init__(name)
+            self.s, self.d = src, dst
+
+        def reads(self):
+            return [self.s]
+
+        def writes(self):
+            return [self.d]
+
+        def apply(self, bufs, ctx):
+            return {self.d: bufs[self.s] * 2.0}
+
+    g = Graph()
+    m1, m2 = Mul("m1", "x", "y"), Mul("m2", "y", "z")
+    g.start_then(m1)
+    g.then(m1, m2)
+    g.then_finish(m2)
+    plat = Platform.make_n_lanes(2)
+    ex = TraceExecutor(plat, {"x": jnp.ones((8, 8)), "y": jnp.zeros((8, 8)),
+                              "z": jnp.zeros((8, 8))})
+    st = State(g)
+    while not st.is_terminal():
+        st = st.apply(st.get_decisions(plat)[0])
+    return ex, st.sequence
+
+
+def test_stepped_timeline_covers_every_position(stepped):
+    ex, seq = stepped
+    tl = stepped_timeline(ex, seq, repeats=2)
+    # every schedule position appears exactly once across the records
+    covered = sorted(p for r in tl.records for p in r.positions)
+    assert covered == list(range(len(seq)))
+    for r in tl.records:
+        if r.kind == "sync":
+            assert r.dur_us == 0.0
+        else:
+            assert r.dur_us > 0.0
+    at = analyze(seq.vector(), tl, measured_us=50.0)
+    assert at.dispatch_overhead_us >= 0.0
+    assert 0.0 < at.overlap_efficiency <= 1.0
+    # m1 -> m2 is a data chain on one lane: both on the critical path
+    assert "m1" in at.critical_path and "m2" in at.critical_path
+
+
+def test_stepped_rejects_mesh_platforms(stepped):
+    ex, seq = stepped
+
+    class FakeMeshPlat:
+        mesh = object()
+        axis_names = ()
+
+    ex2 = type(ex)(ex.platform, ex.init_bufs)
+    ex2.platform = FakeMeshPlat()
+    with pytest.raises(RuntimeError, match="single-chip"):
+        ex2.op_stepped(seq)
+
+
+# -- decision diff: golden facts on the recorded halo corpus ----------------
+
+@pytest.fixture(scope="module")
+def halo_corpus():
+    from tenzing_tpu.bench.benchmarker import CsvBenchmarker
+    from tenzing_tpu.models.halo import HaloArgs
+
+    path = os.path.join(REPO, "experiments", "halo_search_tpu.csv")
+    args = HaloArgs(nq=3, lx=512, ly=512, lz=512, radius=3)
+    try:  # building the halo menu graph pulls in the Pallas kernels; skip
+        # where the container's pallas API predates them (the same env
+        # gate the other recorded-corpus suites hit as plain failures)
+        from tenzing_tpu.models.halo_pipeline import build_graph
+
+        db = CsvBenchmarker.from_file(
+            path, build_graph(args, impl_choice=True), strict=False)
+        db_naive = CsvBenchmarker.from_file(
+            path, build_graph(args, impl_choice=False), strict=False)
+    except (ImportError, AttributeError) as e:  # pragma: no cover - env
+        pytest.skip(f"halo pipeline unavailable in this env: {e}")
+    naive_seq = db_naive.entries[0][0]
+    winner_seq, winner_res = min(db.entries, key=lambda e: e[1].pct50)
+    return naive_seq, winner_seq
+
+
+def test_halo_corpus_diff_golden(halo_corpus):
+    """The recorded r1 winner's attribution facts, pinned against the
+    frozen corpus: two lanes vs naive's one, 57 inversions over the 20
+    shared ops, 12 kernel/engine menu choices resolved differently, and
+    the event_record/event_sync vocabulary the single-lane naive
+    serialization never needs (its program order IS the sync)."""
+    naive_seq, winner_seq = halo_corpus
+    d = diff_schedules(naive_seq.vector(), winner_seq.vector())
+    assert d["lanes"]["naive_lanes"] == [0]
+    assert d["lanes"]["winner_lanes"] == [0, 1]
+    assert d["reorder"]["shared_ops"] == 20
+    assert d["reorder"]["inversions"] == 57
+    assert d["reorder"]["normalized"] == pytest.approx(0.3)
+    # naive needs zero sync ops; the overlap schedule buys its two-lane
+    # concurrency with 5 event_record + 5 event_sync (delta = naive -
+    # winner, so additions show as negative)
+    assert d["sync"]["naive"] == {}
+    assert d["sync"]["winner"] == {"event_record": 5, "event_sync": 5}
+    assert d["sync"]["delta"] == {"event_record": -5, "event_sync": -5}
+    # 12 ops chose a different menu alternative than the naive default
+    assert len(d["menu"]["changed_choices"]) == 12
+    assert d["menu"]["only_in_naive"] == [] and d["menu"]["only_in_winner"] == []
+    assert json.dumps(d)  # JSON-serializable as-is
+
+
+def test_explain_timing_decomposition_is_exact():
+    ops_n = [TOp("a").bind(L0), TOp("b").bind(L0)]
+    ops_w = [TOp("a").bind(L0), TOp("b").bind(L1)]
+    n_at = analyze(ops_n, _timeline(ops_n, {0: 10.0, 1: 20.0}),
+                   measured_us=32.0)
+    w_at = analyze(ops_w, _timeline(ops_w, {0: 9.0, 1: 18.0}),
+                   measured_us=20.0)
+    doc = explain(ops_n, ops_w, naive_attrib=n_at, winner_attrib=w_at)
+    t = doc["timing"]
+    # the three terms sum exactly to the measured delta
+    assert (t["naive_hidden_us"] + t["faster_parts_us"]
+            + t["winner_hidden_us"]) == pytest.approx(t["delta_us"])
+    assert t["delta_us"] == pytest.approx(12.0)
+    assert t["speedup"] == pytest.approx(32.0 / 20.0)
+    assert doc["decisions"]["lanes"]["winner_lanes"] == [0, 1]
+
+
+def test_timeline_trace_events_per_lane_tracks():
+    from tenzing_tpu.obs.export import chrome_trace
+    from tenzing_tpu.obs.tracer import Tracer
+
+    ops = [TOp("a").bind(L0), TOp("b").bind(L1)]
+    at = analyze(ops, _timeline(ops, {0: 10.0, 1: 20.0}), measured_us=22.0)
+    evs = timeline_trace_events(at, pid=0, label="attrib/winner")
+    names = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+    assert names == {"attrib/winner/lane 0", "attrib/winner/lane 1"}
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert {e["tid"] for e in xs} == {1000, 1001}
+    # merged through the export path: spans get named tracks, extras keep
+    # their own metadata, everything lands in one traceEvents list
+    tr = Tracer(enabled=True)
+    with tr.span("bench.benchmark"):
+        pass
+    doc = chrome_trace(tr, extra_events=evs)
+    metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    assert any(m["args"]["name"] == "rank 0" for m in metas)
+    assert any(m["args"]["name"] == "main" for m in metas)
+    assert any(m["args"]["name"] == "attrib/winner/lane 1" for m in metas)
+    assert any(e.get("cat") == "attrib" for e in doc["traceEvents"])
+
+
+# -- histogram truncation surfacing (obs/metrics.py satellite) --------------
+
+def test_histogram_summary_surfaces_truncation():
+    from tenzing_tpu.obs.metrics import Histogram
+
+    h = Histogram("h", max_raw=4)
+    for v in range(10):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 10
+    assert s["raw_retained"] == 4
+    assert s["truncated"] is True
+    h2 = Histogram("h2", max_raw=16)
+    for v in range(10):
+        h2.observe(float(v))
+    assert "truncated" not in h2.summary()
+
+
+# -- report CLI + regression check ------------------------------------------
+
+BASELINE = os.path.join(REPO, "BENCH_r05.json")
+
+
+def _baseline_parsed():
+    with open(BASELINE) as f:
+        return json.load(f)["parsed"]
+
+
+def test_load_driver_json_wrapper_and_raw(tmp_path):
+    from tenzing_tpu.obs.report import load_driver_json
+
+    d = load_driver_json(BASELINE)
+    assert d["metric"].startswith("halo_iter")
+    raw = tmp_path / "raw.json"
+    raw.write_text("stderr noise\n" + json.dumps(d) + "\n")
+    assert load_driver_json(str(raw)) == d
+
+
+def test_regression_check_passes_unmodified_baseline():
+    from tenzing_tpu.obs.report import check_regression
+
+    d = _baseline_parsed()
+    v = check_regression(d, d)
+    assert v["verdict"] == "ok" and not v["reasons"]
+
+
+def test_regression_check_flags_synthetic_slowdown():
+    from tenzing_tpu.obs.report import check_regression
+
+    base = _baseline_parsed()
+    slow = dict(base, vs_baseline=base["vs_baseline"] * 0.8)
+    v = check_regression(slow, base)
+    assert v["verdict"] == "regression"
+    assert any("vs_baseline" in r for r in v["reasons"])
+    # a slower relative value (value/naive) flags independently
+    slow2 = dict(base, value=base["value"] * 1.2)
+    v2 = check_regression(slow2, base)
+    assert v2["verdict"] == "regression"
+    # within tolerance: no flag
+    v3 = check_regression(dict(base, vs_baseline=base["vs_baseline"] * 0.97),
+                          base, tol=0.05)
+    assert v3["verdict"] == "ok"
+
+
+def test_regression_check_noise_aware_inconclusive():
+    from tenzing_tpu.obs.report import check_regression
+
+    base = _baseline_parsed()
+    # a drifting series (monotonic -> 2 runs, |Z| >> 1.96) downgrades the
+    # would-be regression to inconclusive: re-measure, don't flag
+    slow = dict(base, vs_baseline=base["vs_baseline"] * 0.8,
+                attrib={"measured_times": [1.0 + 0.01 * i
+                                           for i in range(20)]})
+    v = check_regression(slow, base)
+    assert v["verdict"] == "inconclusive"
+    # an i.i.d.-looking series keeps the flag
+    import random
+
+    from tenzing_tpu.bench.randomness import is_random
+
+    rng = random.Random(0)
+    noisy = [1.0 + rng.uniform(-0.01, 0.01) for _ in range(20)]
+    assert is_random(noisy)  # sanity: the seeded series passes the runs test
+    slow2 = dict(base, vs_baseline=base["vs_baseline"] * 0.8,
+                 attrib={"measured_times": noisy})
+    assert check_regression(slow2, base)["verdict"] == "regression"
+
+
+def test_report_cli_end_to_end(tmp_path):
+    from tenzing_tpu.obs.report import main
+
+    out = tmp_path / "report.md"
+    rc = main(["--csv",
+               os.path.join(REPO, "experiments", "halo_search_tpu_r5*.csv"),
+               "--bench", BASELINE,
+               "--check", BASELINE, "--baseline", BASELINE,
+               "--out", str(out)])
+    assert rc == 0
+    text = out.read_text()
+    assert "## Recorded search databases" in text
+    assert "## Driver verdicts" in text
+    assert "verdict: ok" in text
+    # regression exit code: a fabricated slowdown returns 1
+    base = _baseline_parsed()
+    slow_p = tmp_path / "slow.json"
+    slow_p.write_text(json.dumps(
+        dict(base, vs_baseline=base["vs_baseline"] * 0.5)))
+    rc2 = main(["--check", str(slow_p), "--baseline", BASELINE,
+                "--out", str(tmp_path / "r2.md")])
+    assert rc2 == 1
+
+
+def test_report_labels_truncated_histograms(tmp_path):
+    from tenzing_tpu.obs.report import main
+
+    mpath = tmp_path / "metrics.json"
+    mpath.write_text(json.dumps({
+        "counters": {}, "gauges": {},
+        "histograms": {
+            "long.series": {"count": 100000, "sum": 12.0, "p50": 1.0,
+                            "p99": 2.0, "raw_retained": 65536,
+                            "truncated": True},
+            # pre-truncated-flag summary: raw_retained alone must still
+            # label prefix-only (legacy metrics JSONs)
+            "old.series": {"count": 500, "sum": 5.0, "p50": 1.0,
+                           "p99": 2.0, "raw_retained": 100},
+            "short.series": {"count": 10, "sum": 1.0, "p50": 0.1,
+                             "p99": 0.2},
+        }}))
+    out = tmp_path / "m.md"
+    assert main(["--metrics", str(mpath), "--out", str(out)]) == 0
+    text = out.read_text()
+    assert "prefix-only (65536/100000)" in text
+    assert "prefix-only (100/500)" in text
+    assert "| short.series | 10 | 1 | 0.1 | 0.2 | full |" in text
+
+
+# -- utils/profiling back-compat shim ---------------------------------------
+
+def test_profiling_shim_reexports_xplane():
+    from tenzing_tpu.obs.attrib import xplane
+    from tenzing_tpu.utils import profiling
+
+    assert profiling.analyze_trace is xplane.analyze_trace
+    assert profiling.capture_trace is xplane.capture_trace
+    assert profiling.merge_intervals is xplane.merge_intervals

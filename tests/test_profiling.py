@@ -1,12 +1,19 @@
-"""Device-side profiling component (SURVEY §5 tracing): trace capture via the
-executor + programmatic overlap analysis.  On CPU the xplane has no device
-planes, so the concurrency numbers are zero — the capture/parse machinery and
-the interval algebra are what these tests pin; the on-TPU evidence lives in
-experiments/PROFILE_OVERLAP.json."""
+"""Device-side xplane profiling (SURVEY §5 tracing, now
+obs/attrib/xplane.py — the attribution profiler's multi-chip fallback):
+trace capture via the executor + programmatic overlap analysis.  On CPU the
+xplane has no device planes, so the concurrency numbers are zero — the
+capture/parse machinery and the interval algebra are what these tests pin;
+the on-TPU evidence lives in experiments/PROFILE_OVERLAP.json.  The
+``utils/profiling.py`` shim's re-export identity is pinned in
+tests/test_attrib.py."""
 
 import numpy as np
 
-from tenzing_tpu.utils.profiling import analyze_trace, capture_trace, merge_intervals
+from tenzing_tpu.obs.attrib.xplane import (
+    analyze_trace,
+    capture_trace,
+    merge_intervals,
+)
 
 
 def test_merge_intervals_coalesces_and_counts_once():
