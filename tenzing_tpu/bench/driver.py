@@ -1,0 +1,1858 @@
+"""The library driver: workload builders + the search→gate→JSON loop.
+
+``bench.py`` used to be a 1,678-line monolith: four workload builders, the
+anytime search (greedy incumbents → recorded warm starts → MCTS →
+hill-climbs), the paired screen/final verdict, the result-integrity gate,
+attribution profiling, and the driver-JSON assembly — all inside one
+``main()`` reachable only through argparse.  The schedule-serving
+subsystem (``tenzing_tpu/serve/``, docs/serving.md) needs exactly that
+loop as a *callable*: a cold request enqueues a work item a driver drains,
+and the warm path needs the workload graphs without a CLI in the way.
+
+This module is that API:
+
+* :class:`DriverRequest` — the typed request, field-for-field the CLI's
+  argparse namespace (defaults asserted equal by tests/test_driver.py, so
+  the two can never drift);
+* :func:`run` — the whole search→gate→JSON loop; returns a
+  :class:`DriverResult` whose ``verdict`` dict, serialized, is
+  byte-identical to the JSON line ``bench.py`` prints;
+* :func:`build_workload` / :func:`graph_for` / :func:`workload_shape` —
+  the workload builders, with a device-free graph/shape path for serving
+  (fingerprints and corpus deserialization must not touch a backend);
+* :exc:`DriverConfigError` — an invalid request (the shim maps it to
+  ``argparse.error``, keeping CLI behavior identical).
+
+``bench.py`` is now a thin argparse shim over this module.
+
+Workloads (``DriverRequest.workload`` / the CLI's ``--workload``):
+* ``halo`` (default, the north-star metric — BASELINE.md): the 3D
+  halo-exchange pipeline (nQ=3, 512^3 cells, radius 3, the reference config
+  halo_run_strategy.hpp:42-49) as six pack -> post -> await -> unpack chains
+  whose transfers are async host round-trip DMAs; MCTS searches order x lane x
+  kernel (XLA slice vs Pallas plane-DMA) against the fully-synchronous naive
+  serialization.
+* ``spmv``: distributed-SpMV iteration (reference config: m=150000 rows,
+  nnz=10*m, band matrix, 2 lanes — spmv_run_strategy.cuh:44-47).
+* ``attn``: single-chip blockwise (flash) attention over a long context —
+  the kernel menu (XLA vs Pallas MXU) plus order x lane space.
+* ``moe``: single-chip MoE dispatch/combine pipeline — routed tokens staged
+  through async host round-trip DMAs to the resident experts (the
+  expert-parallel network-hop analog), searched over order x lane x
+  expert-kernel (XLA vs Pallas) across independent microbatch chunk chains.
+
+The search is anytime: greedy domain incumbents (for halo, an engine x
+lane-count grid), the best recorded schedules from previous runs' databases
+(``--seed-csv``, bench/recorded.py — cross-run search memory ranked by
+in-file paired ratio), and a FastMin MCTS that explores at CHEAP measurement
+cost — search-time numbers only steer the tree — followed by drift-immune
+hill-climbs seeded from the best recorded schedule's menu choices and from
+the strongest hand disciplines.  Candidate selection and the
+verdict are both *paired decorrelated batches* (reference batch benchmark,
+benchmarker.cpp:21-76): a moderate-cost screen ranks the distinct candidates
+by paired per-iteration speedup vs naive and drops anything below 1.0, then
+the final batch (3x iterations, 20x adaptive measurement floor,
+benchmarker.cpp:83-119) re-measures naive + the top 3 survivors together,
+visited in a fresh random order per iteration.  ``vs_baseline`` is the best
+finalist's **paired speedup** (median of naive[k]/cand[k] with a bootstrap
+CI, utils.numeric.paired_speedup) — drift common to both schedules cancels
+instead of masquerading as, or drowning, a schedule difference; a win
+additionally requires the CI to exclude 1.0.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <best pct50, us>, "unit": "us",
+   "vs_baseline": <naive_pct50 / best_pct50>}
+
+On backend-init failure (e.g. the TPU tunnel is down — the way round 1's
+BENCH died, VERDICT r1 item 1) the device is probed first with one retry, and
+failure still prints a parseable JSON line with an ``error`` field.
+
+``--smoke`` runs a tiny CPU-friendly configuration (used by tests/CI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os as _os_mod
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# the CLI's relative default globs (--seed-csv) resolve against the repo
+# root, where bench.py lives — anchored here so the extracted driver keeps
+# resolving the same files the monolith did
+REPO_ROOT = _os_mod.path.dirname(_os_mod.path.dirname(
+    _os_mod.path.dirname(_os_mod.path.abspath(__file__))))
+
+
+class DriverConfigError(ValueError):
+    """An invalid :class:`DriverRequest` — the library analog of
+    ``argparse.ArgumentParser.error`` (the CLI shim catches it and calls
+    exactly that, so bad flag combinations fail identically to the
+    monolith)."""
+
+
+@dataclass
+class DriverRequest:
+    """The driver's typed request — field-for-field the ``bench.py``
+    argparse namespace, with identical defaults (tests/test_driver.py
+    asserts the parser and this dataclass agree, so CLI and API can never
+    drift).  Construct with keyword overrides and hand to :func:`run`;
+    the shim builds one via ``DriverRequest(**vars(args))``."""
+
+    smoke: bool = False
+    workload: str = "halo"
+    moe_tokens: int = 8192
+    m: Optional[int] = None
+    spmv_bw: Optional[int] = None
+    halo_n: int = 512
+    lanes: Optional[int] = None
+    mcts_iters: int = 56
+    iters: int = 20
+    search_iters: int = 6
+    climb_budget: int = 44
+    prefetch_compiles: int = 2
+    dump_csv: Optional[str] = None
+    trace_out: Optional[str] = None
+    metrics_json: Optional[str] = None
+    seed_csv: Optional[str] = None
+    seed_topk: int = 3
+    learn_train: Optional[List[str]] = None
+    learn_trace: Optional[List[str]] = None
+    learn_model: Optional[str] = None
+    learn_screen: bool = False
+    checkpoint: Optional[str] = None
+    resume: bool = False
+    measure_timeout: Optional[float] = None
+    inject_faults: Optional[str] = None
+    inject_hang_secs: float = 60.0
+    profile_winner: bool = False
+    profile_repeats: int = 7
+    no_verify: bool = False
+    verify_tol: float = 0.02
+
+    def to_json(self) -> Dict[str, Any]:
+        """A JSON-ready dict (the serve work-queue payload —
+        ``DriverRequest(**item)`` round-trips)."""
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class DriverResult:
+    """What :func:`run` returns: the verdict dict whose ``json.dumps`` is
+    the driver JSON line (key order preserved — the shim's print is
+    byte-identical to the monolith's)."""
+
+    verdict: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json_line(self) -> str:
+        return json.dumps(self.verdict)
+
+
+def probe_backend(retries: int = 1, wait_secs: float = 15.0):
+    """Initialize the JAX backend, retrying on transient tunnel failure via
+    the shared backoff helper (fault/backoff.py — each retry lands as a
+    ``fault.retry`` obs event with attempt count and error class).  Returns
+    the device list; raises after the final retry."""
+    import jax
+
+    from tenzing_tpu.fault.backoff import BackoffPolicy, retry_call
+
+    def on_retry(e, attempt, delay):
+        sys.stderr.write(f"backend init failed (attempt {attempt + 1}): {e}\n")
+        # a failed init is cached; clear and retry fresh
+        import jax.extend as jex
+
+        jex.backend.clear_backends()
+
+    return retry_call(
+        jax.devices,
+        policy=BackoffPolicy(retries=retries, base_secs=wait_secs,
+                             factor=2.0, jitter=0.25),
+        # the legacy probe retried any RuntimeError from backend init —
+        # broader than the transient-only default, and right here: an init
+        # failure is a tunnel/plugin problem, never a broken schedule
+        retry_on=lambda e: isinstance(e, RuntimeError),
+        where="backend.init",
+        on_retry=on_retry,
+    )
+
+
+# the measured per-face aliased-unpack recipe (the r5 discovery, see
+# experiments/MENU_INCUMBENT2.json / MENU_INCUMBENT3.json): the ghost-shell
+# write must lower IN PLACE (a non-aliased write copies the 2.07 GB grid,
+# ~5 ms) and these are the aliased Pallas kernels per face axis.  ONE
+# definition — the greedy incumbents and the climb seeds must refine the
+# same recipe.
+ALIAS_UNPACK = {"x": ".pallas", "y": ".pallasf", "z": ".pallasb"}
+
+
+def alias_unpack_choice(op_name, choices):
+    """The aliased kernel for an ``unpack_*`` op from the menu, or None when
+    it is off-menu — the one lookup both the greedy seeding and the climb
+    disciplines share."""
+    want = ALIAS_UNPACK[op_name[-1]]
+    return next((c for c in choices if c.endswith(want)), None)
+
+
+def metric_for(workload: str, args) -> str:
+    """The metric name for a workload config — the single source both the
+    success path (build_* return) and the backend-init-failure path use, so
+    the two always land in the same metric series."""
+    if workload == "halo":
+        return f"halo_iter_pct50_searched_n{4 if args.smoke else args.halo_n}"
+    if workload == "spmv":
+        m = args.m if args.m is not None else (512 if args.smoke else 150_000)
+        sfx = f"_bw{args.spmv_bw}" if args.spmv_bw is not None else ""
+        return f"spmv_iter_pct50_searched_m{m}{sfx}"
+    if workload == "moe":
+        t = 32 if args.smoke else args.moe_tokens
+        return f"moe_pipe_pct50_searched_t{t}"
+    n_ctx = 4 * 16 if args.smoke else 8 * 1024
+    return f"attn_blockwise_pct50_searched_n{n_ctx}"
+
+
+def workload_cost(workload: str, built):
+    """The workload's roofline :class:`~tenzing_tpu.bench.roofline.Cost`
+    for the attribution profiler's fraction-of-peak join (``built`` is the
+    matching ``build_*`` return).  One iteration's arithmetic + traffic —
+    the same accounting experiments/halo_roofline.py reports against."""
+    from tenzing_tpu.bench import roofline
+
+    if workload == "halo":
+        h = built[3]
+        return roofline.halo_cost(h.nq, h.lx, h.ly, h.lz, h.radius)
+    if workload == "spmv":
+        m = built[3]
+        return roofline.spmv_cost(m, nnz=10 * m)
+    if workload == "moe":
+        margs = built[3][0]
+        return roofline.moe_cost(margs.tokens, margs.d_model, margs.d_ff,
+                                 staged=True, n_experts=margs.n_experts)
+    a = built[3]  # attn
+    return roofline.attention_cost(a.batch, a.n_devices * a.seq_local,
+                                   a.head_dim)
+
+
+def build_halo(args):
+    from tenzing_tpu.models.halo import HaloArgs
+    from tenzing_tpu.models.halo_pipeline import (
+        build_graph,
+        host_buffer_names,
+        make_pipeline_buffers,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    if args.smoke:
+        hargs = HaloArgs(nq=2, lx=4, ly=4, lz=4, radius=1)
+    else:
+        n = args.halo_n
+        hargs = HaloArgs(nq=3, lx=n, ly=n, lz=n, radius=3)
+    bufs, _ = make_pipeline_buffers(hargs, seed=0, with_expected=False)
+    jbufs = TraceExecutor.place_host_buffers(bufs, host_buffer_names())
+    # kernel + transfer-engine menus only where a real TPU compiles them;
+    # interpret-mode Pallas would dominate a CPU smoke timing
+    impl_choice = not args.smoke
+    g = build_graph(hargs, impl_choice=impl_choice, xfer_choice=impl_choice)
+    return g, jbufs, metric_for("halo", args), hargs
+
+
+def build_spmv(args):
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.models.spmv import (
+        SpMVCompound,
+        make_spmv_buffers,
+        spmv_host_buffer_names,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    m = args.m if args.m is not None else (512 if args.smoke else 150_000)
+    # --spmv-bw widens the band, growing the remote-column exchange relative
+    # to the local compute: the transfer-bound sweep of VERDICT r2 item 7
+    bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, bw=args.spmv_bw, seed=0)
+    jbufs = TraceExecutor.place_host_buffers(bufs, spmv_host_buffer_names())
+    # impl_choice: the kernel menu (XLA gather vs Pallas vreg-gather) is part
+    # of the searched space alongside order and lane assignment; known x sizes
+    # prune Pallas choices that would only alias the XLA path (ADVICE r1).
+    # exchange="host": the x exchange is a posted async host round-trip DMA
+    # (the reference's MPI hop), so the post/wait split gives the search a
+    # real transfer to hide behind the local SpMV
+    x_sizes = {"x_local": int(jbufs["x_local"].shape[0]),
+               "x_remote": int(jbufs["x_remote"].shape[0])}
+    mk = lambda: SpMVCompound(impl_choice=True, x_sizes=x_sizes, exchange="host")
+    g = Graph()
+    g.start_then(mk())
+    g.then_finish(mk())
+    return g, jbufs, metric_for("spmv", args), m
+
+
+def build_moe(args):
+    from tenzing_tpu.models.moe_pipeline import (
+        MoEPipeArgs,
+        build_graph,
+        host_buffer_names,
+        make_pipe_buffers,
+    )
+    from tenzing_tpu.runtime.executor import TraceExecutor
+
+    if args.smoke:
+        margs = MoEPipeArgs(n_experts=4, tokens=32, d_model=8, d_ff=16,
+                            n_chunks=2)
+    else:
+        margs = MoEPipeArgs(tokens=args.moe_tokens)
+    # the searched space includes the staging-precision menu (f32 vs
+    # half-width bf16 transfers) on the real chip
+    staging = "f32" if args.smoke else "choice"
+    bufs, _, cap = make_pipe_buffers(margs, seed=0, with_expected=False,
+                                     staging=staging)
+    jbufs = TraceExecutor.place_host_buffers(
+        bufs, host_buffer_names(margs, staging=staging))
+    impl_choice = not args.smoke  # same rationale as build_halo
+    g = build_graph(margs, cap, impl_choice=impl_choice, staging=staging)
+    return g, jbufs, metric_for("moe", args), (margs, cap)
+
+
+def build_attn(args):
+    import jax.numpy as jnp
+
+    from tenzing_tpu.core.graph import Graph
+    from tenzing_tpu.models.ring_attention import (
+        BlockedAttention,
+        RingAttnArgs,
+        make_blocked_buffers,
+    )
+
+    if args.smoke:
+        aargs = RingAttnArgs(n_devices=4, batch=1, seq_local=16, head_dim=8)
+    else:
+        # 8k context in 8 blocks of 1024, head dim 128
+        aargs = RingAttnArgs(n_devices=8, batch=4, seq_local=1024, head_dim=128)
+    bufs, _ = make_blocked_buffers(aargs, seed=0)
+    bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
+    g = Graph()
+    op = BlockedAttention(aargs, impl_choice=True, fused_choice=True)
+    g.start_then(op)
+    g.then_finish(op)
+    return g, bufs, metric_for("attn", args), aargs
+
+
+# workload name -> device builder (graph + device-placed buffers + metric +
+# workload args) — the search path's entry; serving uses graph_for below
+BUILDERS = {"halo": build_halo, "spmv": build_spmv, "attn": build_attn,
+            "moe": build_moe}
+
+
+def build_workload(req: DriverRequest):
+    """``(graph, buffers, metric, workload-args)`` for ``req`` — the
+    device-placing builder dispatch :func:`run` uses (buffers land in
+    pinned host / device memory; needs an initialized backend)."""
+    return BUILDERS[req.workload](req)
+
+
+def workload_shape(req: DriverRequest) -> Dict[str, int]:
+    """The request's exact shape parameters, as the builders resolve them
+    — THE single source the serving fingerprint keys on (serve/
+    fingerprint.py), kept next to the builders so a new shape knob cannot
+    silently stay out of the fingerprint.  Pure request arithmetic: no
+    jax, no buffers, no backend."""
+    w = req.workload
+    if w == "halo":
+        if req.smoke:
+            return {"nq": 2, "n": 4, "radius": 1}
+        return {"nq": 3, "n": req.halo_n, "radius": 3}
+    if w == "spmv":
+        m = req.m if req.m is not None else (512 if req.smoke else 150_000)
+        # bw resolves exactly as models/spmv.py make_spmv_buffers does
+        # (None -> max(1, m // 8)): a default request and an explicit
+        # --spmv-bw of the same value build the SAME matrix and must
+        # fingerprint identically, or independently-warmed stores
+        # fragment and exact hits are missed
+        bw = req.spmv_bw if req.spmv_bw is not None else max(1, m // 8)
+        return {"m": m, "nnz_per_row": 10, "bw": bw}
+    if w == "moe":
+        if req.smoke:
+            return {"n_experts": 4, "tokens": 32, "d_model": 8, "d_ff": 16,
+                    "n_chunks": 2}
+        return {"tokens": req.moe_tokens}
+    if w == "attn":
+        if req.smoke:
+            return {"n_devices": 4, "batch": 1, "seq_local": 16,
+                    "head_dim": 8}
+        return {"n_devices": 8, "batch": 4, "seq_local": 1024,
+                "head_dim": 128}
+    raise DriverConfigError(f"unknown workload {w!r}")
+
+
+def search_lanes(req: DriverRequest) -> int:
+    """The search platform's lane count for ``req`` — the same default
+    rule :func:`run` applies (8 for full-size halo, else 2, unless
+    overridden), exposed so the serving fingerprint's mesh signature and
+    the search agree by construction."""
+    if req.lanes:
+        return req.lanes
+    return 8 if req.workload == "halo" and not req.smoke else 2
+
+
+def graph_for(req: DriverRequest):
+    """``(graph, nbytes)`` for ``req`` **without touching a backend**: the
+    choice graph recorded schedules deserialize/verify against, plus a
+    buffer-size map for the surrogate featurizer.  The serving path's
+    builder (docs/serving.md): resolution and corpus warm-up must work on
+    a host with no accelerator at all.
+
+    ``nbytes`` is ``{}`` for the full-size halo config — materializing its
+    2 GB grid just to read ``.nbytes`` is not a serving-path cost; the
+    featurizer degrades to zero comm-bytes features, consistently at train
+    and predict time because both sides use this same map.
+
+    The other workloads DO build their (tens-of-MB) host buffers once per
+    fingerprint, deliberately: spmv's choice graph depends on the
+    constructed buffers (``x_sizes`` comes from the random band matrix's
+    actual remote-column split), so deriving sizes analytically here
+    would risk a serving-side graph that silently diverges from the one
+    the driver searches — a correctness risk worth more than a transient
+    allocation that the resolver's per-fingerprint cache amortizes."""
+    w = req.workload
+    impl_choice = not req.smoke
+    if w == "halo":
+        from tenzing_tpu.models.halo import HaloArgs
+        from tenzing_tpu.models.halo_pipeline import build_graph
+
+        s = workload_shape(req)
+        hargs = HaloArgs(nq=s["nq"], lx=s["n"], ly=s["n"], lz=s["n"],
+                         radius=s["radius"])
+        g = build_graph(hargs, impl_choice=impl_choice,
+                        xfer_choice=impl_choice)
+        nbytes: Dict[str, int] = {}
+        if req.smoke:
+            from tenzing_tpu.models.halo_pipeline import make_pipeline_buffers
+
+            bufs, _ = make_pipeline_buffers(hargs, seed=0,
+                                            with_expected=False)
+            nbytes = {k: int(getattr(v, "nbytes", 0))
+                      for k, v in bufs.items()}
+        return g, nbytes
+    if w == "spmv":
+        from tenzing_tpu.core.graph import Graph
+        from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
+
+        s = workload_shape(req)
+        bufs, _ = make_spmv_buffers(m=s["m"], nnz_per_row=s["nnz_per_row"],
+                                    bw=req.spmv_bw, seed=0)
+        x_sizes = {"x_local": int(bufs["x_local"].shape[0]),
+                   "x_remote": int(bufs["x_remote"].shape[0])}
+        mk = lambda: SpMVCompound(impl_choice=True, x_sizes=x_sizes,
+                                  exchange="host")
+        g = Graph()
+        g.start_then(mk())
+        g.then_finish(mk())
+        return g, {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
+    if w == "moe":
+        from tenzing_tpu.models.moe_pipeline import (
+            MoEPipeArgs,
+            build_graph,
+            make_pipe_buffers,
+        )
+
+        margs = MoEPipeArgs(**workload_shape(req))
+        staging = "f32" if req.smoke else "choice"
+        bufs, _, cap = make_pipe_buffers(margs, seed=0, with_expected=False,
+                                         staging=staging)
+        g = build_graph(margs, cap, impl_choice=impl_choice, staging=staging)
+        return g, {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
+    if w == "attn":
+        from tenzing_tpu.core.graph import Graph
+        from tenzing_tpu.models.ring_attention import (
+            BlockedAttention,
+            RingAttnArgs,
+            make_blocked_buffers,
+        )
+
+        aargs = RingAttnArgs(**workload_shape(req))
+        bufs, _ = make_blocked_buffers(aargs, seed=0)
+        g = Graph()
+        op = BlockedAttention(aargs, impl_choice=True, fused_choice=True)
+        g.start_then(op)
+        g.then_finish(op)
+        return g, {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
+    raise DriverConfigError(f"unknown workload {w!r}")
+
+
+class _RunScope:
+    """Per-call registration bookkeeping for :func:`run`.
+
+    The monolith registered its crash-path handlers (telemetry flush,
+    prefetcher shutdown, checkpoint cursor stamps) with ``atexit`` and
+    the signal trap and simply leaked them — correct for a one-shot CLI
+    process, wrong for the library API a work-queue drainer calls in a
+    loop: item N's SIGINT must not fire item N-1's handlers (stamping
+    ``interrupted`` into checkpoints of runs that completed cleanly),
+    and each run's closures must not pin its executor and buffers until
+    process exit.  The scope registers exactly like the monolith while
+    the run is live, then on close runs each exit finalizer once (they
+    are all idempotent — the same calls the success path already makes
+    explicitly) and unregisters everything."""
+
+    def __init__(self):
+        self._finalizers: list = []
+        self._traps: list = []
+
+    def on_exit(self, fn) -> None:
+        """Run ``fn`` at scope close AND (as a crash backstop while the
+        scope is live) at interpreter exit."""
+        import atexit
+
+        atexit.register(fn)
+        self._finalizers.append(fn)
+
+    def on_trap(self, fn) -> None:
+        """Run ``fn`` on SIGINT/SIGABRT while the scope is live."""
+        from tenzing_tpu.utils import trap
+
+        trap.register_handler(fn)
+        self._traps.append(fn)
+
+    def close(self) -> None:
+        import atexit
+
+        from tenzing_tpu.utils import trap
+
+        # LIFO, like the atexit machinery these used to ride on: the
+        # prefetcher's close() (registered after write_telemetry) must
+        # finalize the pipeline counters BEFORE the telemetry flush
+        # writes them out on a crash path
+        for fn in reversed(self._finalizers):
+            try:
+                fn()
+            except Exception as e:  # a failed finalizer must not mask
+                sys.stderr.write(   # the run's own result/exception
+                    f"driver: finalizer {getattr(fn, '__name__', fn)!r} "
+                    f"failed ({type(e).__name__}: {str(e)[:120]})\n")
+        for fn in self._finalizers:
+            atexit.unregister(fn)
+        for fn in self._traps:
+            trap.unregister_handler(fn)
+        self._finalizers.clear()
+        self._traps.clear()
+
+
+def run(req: DriverRequest) -> DriverResult:
+    """Execute the whole search→gate→verdict loop for ``req``.
+
+    Safe to call repeatedly in one process (the work-queue drain loop,
+    docs/serving.md): every atexit/signal registration is scoped to the
+    call and disposed on return, so runs cannot stamp each other's
+    checkpoints or accumulate handlers.  One process-wide caveat: a
+    ``smoke`` request pins ``jax_platforms`` to CPU for the remainder of
+    the process (JAX backend selection is process-global and sticks
+    after first initialization) — drain smoke and full-size items in
+    separate processes."""
+    # a shallow copy: run() resolves defaults in place (seed_csv globs,
+    # smoke iteration caps) exactly like the monolith mutated its argparse
+    # namespace, without surprising a caller who reuses the request
+    args = dataclasses.replace(req)
+    if args.workload not in BUILDERS:
+        # validate BEFORE the backend probe: argparse choices protect
+        # the CLI, but a library caller (a drainer on a hand-edited work
+        # item) must get the API's config error, not a KeyError after a
+        # wasted init/retry cycle — or worse, a backend-failure verdict
+        # mislabeled into metric_for's fall-through metric series
+        raise DriverConfigError(f"unknown workload {args.workload!r}")
+    if args.resume and not args.checkpoint:
+        # silently ignoring resume would re-measure a multi-hour search
+        # from scratch while the output JSON claims a resume happened
+        raise DriverConfigError("--resume requires --checkpoint DIR")
+    scope = _RunScope()
+    try:
+        return _run(args, scope)
+    finally:
+        scope.close()
+
+
+def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
+
+    if args.smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from tenzing_tpu.bench.compile_cache import enable_compile_cache
+
+    compile_cache_dir = enable_compile_cache()
+
+    from tenzing_tpu import obs
+
+    if args.trace_out:
+        obs.configure(enabled=True)
+
+    _telemetry_done = {"v": False}
+    # per-lane Gantt tracks from --profile-winner (chrome trace-event
+    # dicts, obs/attrib/explain.py): filled late in the run, exported by
+    # write_telemetry into the same Perfetto bundle as the PR-1 spans
+    attrib_extra: list = []
+
+    def write_telemetry():
+        """Archive the telemetry bundle once.  Registered with atexit (for
+        crashes: the interpreter still exits normally after an unhandled
+        exception) AND with utils.trap (for SIGINT/SIGABRT: the trap handler
+        re-raises via SIG_DFL, which kills the process without running
+        atexit) so an interrupted search — the run where the trace matters
+        most — still archives everything recorded so far.  The explicit call
+        on the success path just makes the files land before the final JSON
+        line.  Filenames are rank-qualified past rank 0 so multi-host runs
+        writing to a shared directory do not clobber each other's bundles."""
+        import os
+
+        if _telemetry_done["v"]:
+            return
+        _telemetry_done["v"] = True
+        rank = obs.get_tracer().rank
+        sfx = "" if rank == 0 else f".rank{rank}"
+        if args.trace_out:
+            os.makedirs(args.trace_out, exist_ok=True)
+            obs.write_jsonl(obs.get_tracer(),
+                            os.path.join(args.trace_out, f"trace{sfx}.jsonl"))
+            obs.write_chrome_trace(
+                obs.get_tracer(),
+                os.path.join(args.trace_out, f"trace{sfx}.json"),
+                extra_events=attrib_extra or None)
+            sys.stderr.write(f"trace bundle: {args.trace_out}\n")
+        if args.metrics_json:
+            # block=False: this runs from the signal trap, where the
+            # interrupted thread may hold an instrument lock — the
+            # non-blocking read falls back to GIL-atomic copies instead of
+            # deadlocking the Ctrl-C path (the exporters above are
+            # non-blocking by construction, obs/export.py)
+            with open(args.metrics_json + sfx, "w") as f:
+                json.dump(obs.get_metrics().to_json(block=False), f,
+                          indent=2, sort_keys=True)
+            sys.stderr.write(f"metrics: {args.metrics_json}{sfx}\n")
+
+    if args.trace_out or args.metrics_json:
+        scope.on_exit(write_telemetry)
+        scope.on_trap(write_telemetry)
+
+    metric_name = metric_for(args.workload, args)
+    try:
+        devs = probe_backend()
+        sys.stderr.write(f"backend: {devs}\n")
+    except Exception as e:  # still emit a parseable line (VERDICT r1 item 1)
+        write_telemetry()
+        return DriverResult(verdict={
+            "metric": metric_name,
+            "value": -1.0,
+            "unit": "us",
+            "vs_baseline": 0.0,
+            "error": f"backend init failed: {e}",
+        })
+
+    from tenzing_tpu.bench.benchmarker import (
+        BenchOpts,
+        CachingBenchmarker,
+        EmpiricalBenchmarker,
+        result_row,
+    )
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import State
+    from tenzing_tpu.runtime.executor import TraceExecutor
+    from tenzing_tpu.solve.mcts import MctsOpts, explore
+    from tenzing_tpu.solve.mcts.strategies import FastMin
+
+    built = BUILDERS[args.workload](args)
+    g, bufs, metric = built[0], built[1], built[2]
+    # buffer byte sizes feed the surrogate's comm-bytes + analytic-makespan
+    # features (learn/features.py) — the same map for train and screen, so
+    # the feature contract holds across the two phases
+    learn_nbytes = {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
+
+    if args.learn_train:
+        # corpus -> features -> ridge ensemble -> model JSON, then exit:
+        # training is offline (no device measurement), it only needs the
+        # workload graph to deserialize the recorded schedules against
+        import glob as _glob
+
+        from tenzing_tpu import obs as _obs
+        from tenzing_tpu.learn import train_from_corpus
+
+        log = lambda m: sys.stderr.write(m + "\n")
+        paths = sorted(p for pat in args.learn_train
+                       for p in _glob.glob(pat))
+        with _obs.get_tracer().span("learn.train", n_files=len(paths)):
+            tpaths = (sorted(p for pat in args.learn_trace
+                             for p in _glob.glob(pat))
+                      if args.learn_trace else None)
+            # THE shared training recipe (learn/train.py) — the serving
+            # warm path trains through the same call
+            model, info = train_from_corpus(
+                paths, g, nbytes=learn_nbytes, trace_paths=tpaths, log=log)
+            out = {"metric": f"learn_train_{args.workload}", **info}
+            if model is not None and args.learn_model:
+                model.save(args.learn_model)
+                out["model"] = args.learn_model
+                log(f"learn model: {args.learn_model} "
+                    f"({info['rows']} rows, train spearman "
+                    f"{out['train_spearman']})")
+        write_telemetry()
+        return DriverResult(verdict=out)
+
+    surrogate = None
+    if args.learn_screen and args.learn_model:
+        from tenzing_tpu.learn import (
+            FEATURE_NAMES,
+            RidgeEnsemble,
+            SurrogateBenchmarker,
+        )
+
+        model = RidgeEnsemble.load(args.learn_model,
+                                   expect_features=list(FEATURE_NAMES))
+        surrogate = SurrogateBenchmarker(model, nbytes=learn_nbytes)
+        sys.stderr.write(
+            f"learn screen: {args.learn_model} "
+            f"({model.n_train} training rows)\n")
+    elif args.learn_screen:
+        sys.stderr.write("learn screen: no --learn-model given — "
+                         "screening disabled\n")
+    # 8 lanes for halo: the probed greedy lane-count curve peaks at 6-8 lanes
+    # (paired 1.38-1.42 vs 1.18-1.23 at 2) and the repeat driver winner is the
+    # mixed-engine 8-lane incumbent — searching on 8 lanes puts the hill-climb
+    # and MCTS in the same neighborhood instead of a 6-lane one.  Smoke stays
+    # at 2 lanes and a small tree (the CPU path exists to be cheap).
+    # THE default rule lives in search_lanes() — the serving fingerprint's
+    # mesh signature keys on the same call, so the two cannot drift
+    n_lanes = search_lanes(args)
+    plat = Platform.make_n_lanes(n_lanes)
+    if args.smoke:
+        args.mcts_iters = min(args.mcts_iters, 12)
+    ex = TraceExecutor(plat, bufs)
+    emp = EmpiricalBenchmarker(ex)
+    # fault-tolerance stack (docs/robustness.md), inside-out:
+    #   EmpiricalBenchmarker            device measurement
+    #   [FaultInjectingBenchmarker]     --inject-faults seeded chaos
+    #                                   (measurement-fault kinds)
+    #   [PrefetchingBenchmarker]        --prefetch-compiles async compile
+    #                                   pipeline: solver hints AOT-compile
+    #                                   in the background, failures surface
+    #                                   on the foreground call so the
+    #                                   resilient layer above classifies /
+    #                                   agrees / quarantines as usual
+    #   ResilientBenchmarker            soundness gate / watchdog /
+    #                                   classified retry / quarantine /
+    #                                   degradation
+    #   [FaultInjectingBenchmarker]     --inject-faults corrupt: schedule
+    #                                   corruption — ABOVE the resilient
+    #                                   layer so its verifier gate sees
+    #                                   (and quarantines) the mutation
+    #   [JournalingBenchmarker]         --checkpoint measurement journal
+    #   CachingBenchmarker              equivalence-keyed cache (also the
+    #                                   --resume restore target)
+    from tenzing_tpu.fault import (
+        JournalingBenchmarker,
+        Quarantine,
+        ResilientBenchmarker,
+        SearchCheckpoint,
+    )
+    from tenzing_tpu.verify import ScheduleVerifier
+
+    verifier = None if args.no_verify else ScheduleVerifier(g)
+    inner_specs, corrupt_specs = [], []
+    if args.inject_faults:
+        from tenzing_tpu.fault import parse_inject_specs
+
+        specs = parse_inject_specs(args.inject_faults)
+        inner_specs = [s for s in specs if s.kind != "corrupt"]
+        corrupt_specs = [s for s in specs if s.kind == "corrupt"]
+        if corrupt_specs and verifier is None:
+            # corruption without the verifier would MEASURE broken
+            # schedules — a chaos run that poisons its own archive
+            raise DriverConfigError(
+                "--inject-faults corrupt: requires the soundness "
+                "verifier (drop --no-verify)")
+        sys.stderr.write(f"chaos: injecting {args.inject_faults}\n")
+    measured_stack = emp
+    injector = None
+    if inner_specs:
+        from tenzing_tpu.fault import FaultInjectingBenchmarker
+
+        injector = FaultInjectingBenchmarker(
+            emp, inner_specs, hang_secs=args.inject_hang_secs)
+        measured_stack = injector
+    prefetcher = None
+    if args.prefetch_compiles > 0 and args.resume:
+        # a resumed run answers journaled measurements without touching the
+        # executor (the PR 3 "0 compiles" provenance); background hints
+        # would compile programs the journal already answers — keep the
+        # resume contract and skip the pipeline
+        sys.stderr.write("prefetch: disabled under --resume (journaled "
+                         "answers never compile)\n")
+    elif args.prefetch_compiles > 0:
+        from tenzing_tpu.bench.pipeline import PrefetchingBenchmarker
+
+        # ABOVE injection (background compiles are not chaos targets — the
+        # injector's per-attempt draws stay keyed to benchmark() calls
+        # only) and BELOW the resilient layer (surfaced compile failures
+        # ride the normal classify/agree/quarantine path)
+        measured_stack = prefetcher = PrefetchingBenchmarker(
+            measured_stack, executor=ex, workers=args.prefetch_compiles,
+            rank=surrogate)
+        # exception paths too (not only the happy-path close below): a
+        # fatal mid-search error must not leave queued background compiles
+        # draining at interpreter exit — the pool's own shutdown hook joins
+        # only AFTER the queue empties (~3.4 s per pending compile), while
+        # close() cancels pending first.  Idempotent; SIGINT has the trap.
+        scope.on_exit(prefetcher.close)
+    ckpt = SearchCheckpoint(args.checkpoint) if args.checkpoint else None
+    quar = Quarantine(ckpt.quarantine_path if ckpt else None,
+                      log=lambda m: sys.stderr.write(m + "\n"))
+    if len(quar):
+        sys.stderr.write(
+            f"quarantine: {len(quar)} schedule(s) carried from previous "
+            "runs will not be re-measured\n")
+    resilient = ResilientBenchmarker(
+        measured_stack, timeout_secs=args.measure_timeout, quarantine=quar,
+        fallback=surrogate, verifier=verifier)
+    guarded = resilient
+    corrupt_injector = None
+    if corrupt_specs:
+        from tenzing_tpu.fault import FaultInjectingBenchmarker
+
+        corrupt_injector = FaultInjectingBenchmarker(
+            resilient, corrupt_specs,
+            unsound_check=lambda o: not verifier(o).ok)
+        guarded = corrupt_injector
+    bench = CachingBenchmarker(
+        JournalingBenchmarker(guarded, ckpt) if ckpt else guarded)
+    if ckpt is not None:
+        config = {"workload": args.workload, "metric": metric,
+                  "smoke": bool(args.smoke), "seed_topk": args.seed_topk}
+        prior = None
+        try:
+            prior = ckpt.load_state()
+        except Exception as e:  # corrupt snapshot: resume from journal only
+            sys.stderr.write(f"checkpoint: state unreadable ({e}); "
+                             "journal + quarantine still apply\n")
+        if prior is not None and prior.get("config") not in (None, config):
+            sys.stderr.write(
+                "checkpoint: recorded config differs from this run "
+                f"({prior.get('config')} vs {config}); journal rows that "
+                "do not resolve against this workload are skipped\n")
+        want_inject = args.inject_faults or None
+        if args.resume and prior is not None and \
+                prior.get("inject") != want_inject:
+            # a resumed chaos run whose injection spec disagrees with the
+            # one the checkpoint was written under would replay journaled
+            # answers from a DIFFERENT fault universe and silently diverge
+            # from both the original run and a clean rerun — refuse loudly
+            raise DriverConfigError(
+                "--resume: this run's --inject-faults "
+                f"({want_inject!r}) disagrees with the checkpoint's "
+                f"recorded injection spec ({prior.get('inject')!r}); "
+                "use the same spec (including seeds) or start a fresh "
+                "checkpoint directory")
+        if args.resume:
+            restored = ckpt.restore_into(
+                bench, g, log=lambda m: sys.stderr.write(m + "\n"))
+            sys.stderr.write(
+                f"resume: {restored} recorded measurement(s) restored — "
+                "already-measured schedules will not touch the device\n")
+        ckpt.save_state(config=config, inject=want_inject)
+
+        # final snapshots: the journal and quarantine are already on disk
+        # (appended/rewritten as each measurement landed), so these only
+        # stamp the cursor document.  The trap path marks the interrupt
+        # (SIG_DFL then kills without running the exit finalizers); a
+        # normal return (or crash) marks completion at scope close.
+        scope.on_exit(lambda: ckpt.save_state(done=True))
+        scope.on_trap(lambda: ckpt.save_state(interrupted=True))
+    # max_retries=2 (library default 10): the runs-test retry loop re-measures
+    # the whole series on rejection, and in the tunnel's slow regime that blew
+    # a single naive benchmark to 558 s of wall; the verdict comes from the
+    # paired batches (which have no retry loop), so the search-phase numbers
+    # only need to be cheap, not certified-stationary
+    opts = BenchOpts(n_iters=max(5, args.iters), max_retries=2,
+                     target_secs=0.002 if args.smoke else 0.02)
+    # the search phase buys BREADTH with cheap measurements (VERDICT r2 weak
+    # #2: 24 iters at full measurement cost explored a 109-node tree of a far
+    # larger space); ranking candidates is the paired screening batch's job,
+    # so search-time numbers only need to steer the tree
+    search_opts = BenchOpts(
+        n_iters=max(3, args.search_iters),
+        max_retries=2,
+        target_secs=0.002 if args.smoke else 0.01,
+    )
+
+    # naive incumbent: the fully-synchronous serialization on one lane (the
+    # reference's "sequential ordering on one stream" baseline, BASELINE.json)
+    naive_plat = Platform.make_n_lanes(1)
+    if args.workload == "halo":
+        from tenzing_tpu.models.halo_pipeline import naive_order
+
+        naive_seq = naive_order(built[3], naive_plat)
+    elif args.workload == "moe":
+        from tenzing_tpu.models.moe_pipeline import naive_order
+
+        naive_seq = naive_order(built[3][0], built[3][1], naive_plat)
+    else:
+        naive_state = State(g)
+        while not naive_state.is_terminal():
+            naive_state = naive_state.apply(naive_state.get_decisions(naive_plat)[0])
+        naive_seq = naive_state.sequence
+    # the baseline is not a search candidate: exempt it from the
+    # identity-keyed candidate-fault kinds (deterministic/corrupt), which
+    # would otherwise deterministically kill the run under ~rate of the
+    # seeds before the search starts.  Tunnel-fault kinds still apply.
+    for inj in (injector, corrupt_injector):
+        if inj is not None:
+            from tenzing_tpu.bench.benchmarker import schedule_id as _sid
+
+            inj.exempt_ids.add(_sid(naive_seq))
+    if prefetcher is not None:
+        # hint the baseline itself: its compile starts on a worker while
+        # argument/driver setup finishes, the foreground join consumes it,
+        # and every run deterministically exercises the AOT-program /
+        # prepare_n cache-key agreement on the real executor (the CI smoke
+        # asserts prefetch hits > 0 on exactly this)
+        prefetcher.prefetch([naive_seq])
+    t0 = time.time()
+    naive = bench.benchmark(naive_seq, opts)
+    sys.stderr.write(f"naive: pct50={naive.pct50*1e6:.1f}us (wall {time.time()-t0:.0f}s)\n")
+
+    # anytime search: heuristic incumbents first, then the directed search.
+    # For halo the domain heuristic is the post-all-before-await-any overlap
+    # discipline — the one the reference's graph hard-codes via its
+    # every-post-before-any-wait edges (ops_halo_exchange.cu:249-256)
+    incumbents = []
+    incumbent_labels: dict = {}
+    # MCTS warm-start seeds: incumbent disciplines as DECISION PATHS on the
+    # search platform over the choice graph (filled alongside the incumbents;
+    # VERDICT r3 item 1)
+    seed_paths = []
+    # informed MCTS playouts: rollouts complete with the workload's best
+    # hand discipline (epsilon-noised) instead of uniform random — a
+    # ~100-decision halo schedule essentially never assembles a coherent
+    # discipline by chance, which is why random-playout MCTS lagged the
+    # climbs for four rounds (VERDICT r4 item 2)
+    mcts_rollout_policy = None
+    if args.workload == "attn" and not args.smoke:
+        # kernel incumbents: (a) the per-block chain with every block on the
+        # bf16 Pallas kernel (the r2-r4 winner), (b) the fused single-kernel
+        # flash with VMEM-resident state (the r5 HBM-state-traffic fix) —
+        # the directed search starts from both, the final batch must include
+        # whichever survives the screen
+        from tenzing_tpu.core.state import ChooseOp
+        from tenzing_tpu.solve.mcts.mcts import SimResult
+
+        def attn_incumbent(label, engine_suffix, kernel_suffix):
+            st = State(g)
+            while not st.is_terminal():
+                ds = st.get_decisions(naive_plat)
+                pick = next(
+                    (d for d in ds if isinstance(d, ChooseOp)
+                     and d.choice.name().endswith(engine_suffix)),
+                    None,
+                ) or next(
+                    (d for d in ds if isinstance(d, ChooseOp)
+                     and d.choice.name().endswith(kernel_suffix)),
+                    ds[0],
+                )
+                st = st.apply(pick)
+            t0 = time.time()
+            try:
+                res_i = bench.benchmark(st.sequence, search_opts)
+            except Exception as e:
+                sys.stderr.write(
+                    f"{label} incumbent rejected ({type(e).__name__}: "
+                    f"{str(e)[:160]})\n")
+                return
+            sys.stderr.write(
+                f"{label} incumbent: pct50={res_i.pct50*1e6:.1f}us "
+                f"(wall {time.time()-t0:.0f}s)\n"
+            )
+            sim = SimResult(order=st.sequence, result=res_i)
+            incumbent_labels[id(sim)] = label
+            incumbents.append(sim)
+
+        attn_incumbent("bf16-kernel", ".chain", ".pallas_bf16")
+        attn_incumbent("fused-bf16", ".fused_bf16", ".pallas_bf16")
+    if args.workload in ("halo", "moe"):
+        from tenzing_tpu.solve.mcts.mcts import SimResult
+
+        if args.workload == "halo":
+            from tenzing_tpu.models.halo_pipeline import (
+                greedy_overlap_order,
+                paired_overlap_order,
+            )
+
+            greedy_seqs = []
+            if args.smoke:
+                greedy_seqs.append(
+                    ("greedy-overlap", greedy_overlap_order(built[3], plat)))
+            else:
+                from tenzing_tpu.models.halo import (
+                    DIRECTIONS as _DIRS,
+                    dir_name as _dn,
+                )
+                from tenzing_tpu.models.halo_pipeline import (
+                    HALO_PHASES as _PH,
+                    paired_priority,
+                )
+                from tenzing_tpu.solve.local import drive, phase_policy
+
+                _dirs = [_dn(d) for d in _DIRS]
+
+                def mk_prefer(engine):
+                    def prefer(op_name, choices):
+                        if op_name.startswith("xfer_"):
+                            i = _dirs.index(op_name.split("_", 1)[1])
+                            want = {"host": ".host", "rdma": ".rdma",
+                                    "alias": ".rdma"}.get(
+                                engine, ".rdma" if i % 2 == 0 else ".host")
+                            return next(
+                                (c for c in choices if c.endswith(want)), None)
+                        if engine == "alias" and op_name.startswith("unpack_"):
+                            hit = alias_unpack_choice(op_name, choices)
+                            if hit is not None:
+                                return hit
+                        return next(
+                            (c for c in choices if c.endswith(".xla")), None)
+
+                    return prefer
+
+                # rollouts complete with the measured r5 alias discipline
+                # (phase_policy is stateful via its lane round-robin, which
+                # adds completion diversity on top of rollout_eps)
+                mcts_rollout_policy = phase_policy(
+                    plat, _PH, mk_prefer("alias"))
+
+                # search-platform (8-lane) incumbents are driven on the
+                # CHOICE graph itself, and their decision paths double as the
+                # MCTS warm-start seeds (re-measured at the cheap screen
+                # floor — a few ms of device time — since the multi-fidelity
+                # split keys the cache per-floor)
+                for label, engine, pri in (
+                    ("greedy-host-8l", "host", None),
+                    ("greedy-rdma-8l", "rdma", None),
+                    ("greedy-mixed-8l", "mixed", None),
+                    ("greedy-paired-8l", "mixed", paired_priority("mixed")),
+                    ("greedy-alias-8l", "alias", None),
+                ):
+                    seq, decs = drive(g, plat, phase_policy(
+                        plat, _PH, mk_prefer(engine), priority=pri))
+                    greedy_seqs.append((label, seq))
+                    seed_paths.append(decs)
+                # other lane counts: engine-fixed graphs (probed on v5e:
+                # rdma peaks at 2-3 lanes, mixed also strong at 6)
+                for label, engine, nl in (
+                    ("greedy-rdma-2l", "rdma", 2),
+                    ("greedy-rdma-3l", "rdma", 3),
+                    ("greedy-mixed-6l", "mixed", 6),
+                ):
+                    greedy_seqs.append((label, greedy_overlap_order(
+                        built[3], Platform.make_n_lanes(nl), engine=engine)))
+                greedy_seqs.append(("greedy-paired-6l", paired_overlap_order(
+                    built[3], Platform.make_n_lanes(6), engine="mixed")))
+                # the aliased-unpack recipe at the probed lane counts
+                # (experiments/MENU_INCUMBENT3.json: 3.2-3.4x paired at
+                # 2/3/6 lanes, best at 6) — driven on the choice graph so
+                # their decision paths also seed the tree
+                for label, nl in (("greedy-alias-3l", 3),
+                                  ("greedy-alias-6l", 6)):
+                    plat_a = Platform.make_n_lanes(nl)
+                    seq, decs = drive(g, plat_a, phase_policy(
+                        plat_a, _PH, mk_prefer("alias")))
+                    greedy_seqs.append((label, seq))
+                    seed_paths.append(decs)
+        else:
+            from tenzing_tpu.models.moe_pipeline import greedy_overlap_order
+
+            margs_, cap_ = built[3]
+            greedy_seqs = [
+                ("greedy-overlap", greedy_overlap_order(margs_, cap_, plat))
+            ]
+            if not args.smoke:
+                # the half-width-transfer incumbent (bf16 staging) and the
+                # device-resident-transfer incumbents (rdma engine): the
+                # likely winners the search should start from
+                greedy_seqs.append((
+                    "greedy-overlap-bf16",
+                    greedy_overlap_order(margs_, cap_, plat, staging="bf16"),
+                ))
+                greedy_seqs.append((
+                    "greedy-bf16-rdma",
+                    greedy_overlap_order(margs_, cap_, plat, staging="bf16",
+                                         engine="rdma"),
+                ))
+                greedy_seqs.append((
+                    "greedy-f32-rdma",
+                    greedy_overlap_order(margs_, cap_, plat, engine="rdma"),
+                ))
+        if prefetcher is not None:
+            # the incumbent grid is known up front: incumbent k+1 compiles
+            # in the background while incumbent k measures
+            prefetcher.prefetch([s for _, s in greedy_seqs])
+        for label, greedy_seq in greedy_seqs:
+            t0 = time.time()
+            # search-phase cost: incumbents are re-ranked by the paired
+            # screen anyway, this number only seeds the tree
+            greedy = bench.benchmark(greedy_seq, search_opts)
+            sys.stderr.write(
+                f"{label} incumbent: pct50={greedy.pct50*1e6:.1f}us "
+                f"(wall {time.time()-t0:.0f}s)\n"
+            )
+            sim = SimResult(order=greedy_seq, result=greedy)
+            incumbent_labels[id(sim)] = label
+            incumbents.append(sim)
+
+    # recorded-best warm start: the best distinct schedules from previous
+    # runs' search databases are first-class candidates (the search
+    # remembers its own discoveries across runs — CSV checkpoint/resume, the
+    # reference's mcts_csv workflow) and, below, a hill-climb seed
+    # discipline.  r4l motivated this: r4k's climb discovered the
+    # batched-z-unpack combination at paired 2.48, and the next run's climbs
+    # wandered to 1.42 local optima instead of starting from it.
+    recorded = []  # best-first sequences, filled below
+    if args.seed_csv is None:
+        args.seed_csv = {
+            "halo": "experiments/halo_search_tpu_r[45]*.csv",
+            "moe": "experiments/moe_search_tpu_r[45]*.csv",
+            "attn": "experiments/attn_search_tpu_r[45]*.csv",
+        }.get(args.workload, "")
+    if args.seed_csv and args.seed_topk > 0 and not args.smoke:
+        import glob as _glob
+        import os.path as _osp
+
+        from tenzing_tpu.bench.recorded import rank_recorded
+        from tenzing_tpu.solve.mcts.mcts import SimResult
+
+        pat = args.seed_csv
+        if not _osp.isabs(pat):
+            pat = _osp.join(REPO_ROOT, pat)
+        paths = sorted(_glob.glob(pat))
+        if not paths:
+            sys.stderr.write(f"recorded db: no files match {pat!r}\n")
+        picked = rank_recorded(
+            paths, g, args.seed_topk,
+            log=lambda m: sys.stderr.write(m + "\n"),
+        )
+        recorded_ok = []
+        if prefetcher is not None:
+            prefetcher.prefetch([s for s, _ in picked])
+        from tenzing_tpu.fault.backoff import BackoffPolicy as _BP, retry_call
+
+        for ri, (seq_r, ratio) in enumerate(picked):
+            t0 = time.time()
+            # transient-classified retry via the shared backoff helper (the
+            # tunnel has flaky spells); a deterministic failure — a recorded
+            # schedule this chip genuinely cannot run — drops immediately
+            try:
+                meas = retry_call(
+                    lambda seq_r=seq_r: bench.benchmark(seq_r, search_opts),
+                    policy=_BP(retries=1, base_secs=2.0),
+                    where="recorded.warmstart",
+                )
+            except Exception as err:
+                sys.stderr.write(
+                    f"recorded[{ri}] dropped "
+                    f"({type(err).__name__}: {str(err)[:200]})\n"
+                )
+                continue
+            sys.stderr.write(
+                f"recorded[{ri}] candidate: pct50={meas.pct50*1e6:.1f}us "
+                f"(recorded ratio {ratio:.3f}, wall {time.time()-t0:.0f}s)\n"
+            )
+            sim = SimResult(order=seq_r, result=meas)
+            incumbent_labels[id(sim)] = f"recorded[{ri}]"
+            incumbents.append(sim)
+            recorded_ok.append((seq_r, meas.pct50))
+        # best by RE-MEASURED time first for the climb seed (this run's
+        # regime, same fidelity across the three)
+        recorded = [s for s, _ in sorted(recorded_ok, key=lambda e: e[1])]
+
+    # moe warm-start seed (halo's were recorded with its incumbents above)
+    if not args.smoke and args.workload == "moe":
+        from tenzing_tpu.models.moe_pipeline import PHASES as _MOE_PH
+        from tenzing_tpu.solve.local import drive, phase_policy
+
+        def moe_seed_prefer(op_name, choices):
+            return next(
+                (c for c in choices if c.endswith(".bf16-rdma")),
+                next((c for c in choices if c.endswith(".xla")), None),
+            )
+
+        _, decs = drive(g, plat, phase_policy(plat, _MOE_PH, moe_seed_prefer))
+        seed_paths.append(decs)
+        mcts_rollout_policy = phase_policy(plat, _MOE_PH, moe_seed_prefer)
+
+    # directed search over the order x lane x kernel x engine space, at the
+    # cheap search-phase measurement cost.  Multi-fidelity (VERDICT r4 item
+    # 2): rollouts are measured at a ~1 ms screen floor — search-time numbers
+    # only steer the tree — and the top-k distinct schedules are re-measured
+    # at the climb floor before the dump, so MCTS's official candidates carry
+    # comparable-fidelity numbers into the paired screen
+    t0 = time.time()
+    mcts_screen = BenchOpts(
+        n_iters=2, max_retries=2,
+        target_secs=0.0005 if args.smoke else 0.001,
+    )
+    mcts_confirm = BenchOpts(
+        n_iters=max(5, args.iters), max_retries=2,
+        target_secs=search_opts.target_secs * 10,
+    )
+    search_bench = bench
+    if surrogate is not None:
+        # the learned screen slots into the existing screen/confirm split:
+        # rollout queries (mcts_screen opts) may be answered by the model,
+        # while the confirm pass and everything at any other fidelity
+        # always reaches the device (screen_only_opts)
+        from tenzing_tpu.learn import ScreeningBenchmarker
+
+        search_bench = ScreeningBenchmarker(
+            surrogate, bench, escalate_topk=max(4, args.seed_topk + 1),
+            screen_only_opts=mcts_screen,
+        )
+    res = explore(
+        g,
+        plat,
+        search_bench,
+        MctsOpts(n_iters=args.mcts_iters, bench_opts=mcts_confirm,
+                 screen_opts=mcts_screen, confirm_topk=4, seed=0,
+                 rollout_policy=mcts_rollout_policy,
+                 checkpoint=ckpt, verify=verifier, prefetch=prefetcher),
+        strategy=FastMin,
+        seeds=seed_paths,
+    )
+    if surrogate is not None:
+        sys.stderr.write(
+            f"learn screen: {search_bench.hits} surrogate answers / "
+            f"{search_bench.escalations} escalations\n")
+    confirmed = [s for s in res.sims if s.fidelity == "full"]
+    best_seen = min(
+        (s.result.pct50 for s in (confirmed or res.sims)),
+        default=float("inf"),
+    )
+    sys.stderr.write(
+        f"mcts wall {time.time()-t0:.0f}s, tree={res.tree_size}, "
+        f"{len(res.sims)} rollouts ({len(seed_paths)} seeded, "
+        f"{len(confirmed)} confirmed at {mcts_confirm.target_secs}s floor), "
+        f"best-seen pct50={best_seen*1e6:.1f}us\n"
+    )
+    # where the search wall goes (VERDICT r3 weak #5): per-phase counters +
+    # benchmark-cache economics in the driver tail
+    if res.counters is not None:
+        sys.stderr.write(res.counters.report() + "\n")
+    sys.stderr.write(
+        f"bench cache: {bench.hits} hits / {bench.misses} misses; "
+        f"compiled programs: {ex.compile_count} "
+        f"({ex.compile_secs:.1f}s compile wall)\n"
+    )
+    if prefetcher is not None:
+        pst = prefetcher.stats()
+        sys.stderr.write(
+            "prefetch: %(issued)d issued / %(hits)d hits / %(wasted)d "
+            "wasted / %(failed)d failed / %(dropped)d dropped\n" % pst)
+    res.sims = incumbents + res.sims
+
+    # neighborhood search from the best-known heuristic: hill-climb in
+    # decision space (solve/local.py) refines it with measured
+    # single-substitution moves — the local complement to MCTS's global
+    # exploration, at the same cheap search cost
+    climb_cfg = []
+
+    def recorded_prefer_and_lanes():
+        """(prefer, n_lanes) replicating the best recorded schedule's menu
+        choices — the climb starts in the recorded winner's kernel/engine
+        configuration and searches order/lane/flip moves from there."""
+        from tenzing_tpu.core.serdes import sequence_to_json
+
+        js = sequence_to_json(recorded[0])
+        chosen: dict = {}
+        for j in js:
+            n = j.get("name", "")
+            if "." in n:
+                base, suffix = n.rsplit(".", 1)
+                chosen.setdefault(base, "." + suffix)
+
+        def prefer(op_name, choices):
+            want = chosen.get(op_name)
+            if want is not None:
+                c = next((c for c in choices if c.endswith(want)), None)
+                if c is not None:
+                    return c
+            if op_name.startswith("xfer_"):
+                # a recorded host-staged transfer leaves no "xfer_*" vertex
+                # (the HostRoundTrip compound expands into spill/fetch)
+                return next((c for c in choices if c.endswith(".host")), None)
+            return next((c for c in choices if c.endswith(".xla")), None)
+
+        lanes_used = [j.get("lane") for j in js if j.get("lane") is not None]
+        return prefer, (max(lanes_used) + 1 if lanes_used else 2)
+
+    if args.workload == "halo" and not args.smoke:
+        from tenzing_tpu.models.halo_pipeline import HALO_PHASES
+
+        def alias_prefer(op_name, choices):
+            # all-rdma + the aliased-unpack kernel map (the measured r5
+            # recipe: in-place ghost-shell writes per face, MENU_INCUMBENT2/3)
+            if op_name.startswith("xfer_"):
+                return next((c for c in choices if c.endswith(".rdma")), None)
+            if op_name.startswith("unpack_"):
+                hit = alias_unpack_choice(op_name, choices)
+                if hit is not None:
+                    return hit
+            return next((c for c in choices if c.endswith(".xla")), None)
+
+        # climbs: one seeded from the best RECORDED schedule's menu choices
+        # (when a database is present — the cross-run memory), then the two
+        # strongest measured disciplines, split 4:3: the aliased-unpack
+        # all-rdma recipe at its two best probed lane counts
+        # (MENU_INCUMBENT3.json: 3.2-3.4x paired at 3 and 6 lanes) — the
+        # climb refines order/lane/kernel-flip moves from there
+        b_rec = (args.climb_budget // 3) if recorded else 0
+        rest = args.climb_budget - b_rec
+        b1 = (rest * 4) // 7
+        plat3 = Platform.make_n_lanes(3)
+        climb_cfg = [
+            (plat3, HALO_PHASES, alias_prefer, None, b1),
+            (Platform.make_n_lanes(6), HALO_PHASES, alias_prefer, None,
+             rest - b1),
+        ]
+        if b_rec:
+            rec_prefer, n_rec = recorded_prefer_and_lanes()
+            climb_cfg.insert(
+                0,
+                (Platform.make_n_lanes(n_rec), HALO_PHASES, rec_prefer, None,
+                 b_rec),
+            )
+    elif args.workload == "moe" and not args.smoke:
+        from tenzing_tpu.models.moe_pipeline import PHASES as MOE_PHASES
+
+        def moe_prefer(op_name, choices):
+            # whole-chain staging choice: device-resident bf16 transfers (the
+            # measured 10.97x winner); kernel choices default to XLA
+            return next(
+                (c for c in choices if c.endswith(".bf16-rdma")),
+                next((c for c in choices if c.endswith(".xla")), None),
+            )
+
+        b_rec = (args.climb_budget // 2) if recorded else 0
+        climb_cfg = [(plat, MOE_PHASES, moe_prefer, None,
+                      args.climb_budget - b_rec)]
+        if b_rec:
+            rec_prefer, n_rec = recorded_prefer_and_lanes()
+            climb_cfg.insert(
+                0,
+                (Platform.make_n_lanes(n_rec), MOE_PHASES, rec_prefer, None,
+                 b_rec),
+            )
+    if climb_cfg and args.climb_budget > 0:
+        from dataclasses import replace as _replace
+
+        from tenzing_tpu.solve.local import LocalOpts, hill_climb
+
+        # paired=True: accept moves only on a back-to-back paired comparison
+        # with the incumbent — the r4a run showed unpaired first-improvement
+        # climbing chases chip drift (climb "best" 96 ms that the paired
+        # screen ranked below its own seed).  Accepts run at SCREEN fidelity
+        # (r4c: accepts at the cheap 0.01s floor did not replicate under the
+        # screen's 0.1s floor — measurement-regime-dependent overlap), which
+        # costs ~1.6s of measurement per neighbor on top of the ~3s compile.
+        climb_opts = _replace(search_opts, n_iters=8,
+                              target_secs=10 * search_opts.target_secs)
+        for ci, (cplat, cphases, cprefer, cpriority, cbudget) in enumerate(
+            climb_cfg
+        ):
+            t0 = time.time()
+            lres = hill_climb(
+                g, cplat, bench, cphases, prefer=cprefer, priority=cpriority,
+                opts=LocalOpts(budget=cbudget, bench_opts=climb_opts,
+                               seed=2 + ci, paired=True,
+                               prescreen=surrogate, checkpoint=ckpt,
+                               verify=verifier, prefetch=prefetcher),
+            )
+            lbest = lres.best()
+            sys.stderr.write(
+                f"hill-climb[{ci}] ({len(cplat.lanes)} lanes): "
+                f"{len(lres.sims)} candidates, best "
+                f"pct50={lbest.result.pct50*1e6:.1f}us "
+                f"(wall {time.time()-t0:.0f}s)\n"
+            )
+            for s in lres.sims:
+                incumbent_labels[id(s)] = "climb"
+            res.sims = res.sims + lres.sims
+            if lres.final is not None:
+                # the accepted chain tip is the climb's official output: it
+                # always advances to the paired screen, like the incumbents
+                incumbent_labels[id(lres.final)] = "climb-tip"
+                incumbents.append(lres.final)
+                res.sims = res.sims + [lres.final]
+
+    # Candidate selection is DRIFT-IMMUNE (VERDICT r2 weak #1: raw search-
+    # phase pct50s picked final candidates while naive drifted 254ms -> 129ms
+    # within one run, and 2 of 4 finalists lost to naive).  Two paired
+    # decorrelated batches (reference batch benchmark, benchmarker.cpp:21-76):
+    #
+    #   screen: naive + the distinct candidates (incumbent grid + top
+    #           searched), moderate cost; paired
+    #           per-iteration speedups rank them, dropping everything whose
+    #           paired median is < 1.0 — search-time drift cancels because
+    #           iteration k visits every schedule back-to-back;
+    #   final:  naive + the top 3 screened, 3x iterations and a 20x adaptive
+    #           measurement floor (the reference's >=10ms floor scaled up,
+    #           benchmarker.cpp:83-119) so single-execution jitter cannot
+    #           widen the bootstrap CI across 1.0 when the margin is real.
+    #
+    # All programs are already compiled (executor cache) — pure measurement.
+    from dataclasses import replace
+
+    from tenzing_tpu.bench.benchmarker import BenchResult
+    from tenzing_tpu.core.sequence import canonical_key
+    from tenzing_tpu.utils.numeric import paired_speedup
+
+    def batch_paired(seqs, bopts, seed):
+        """(results, paired-vs-naive) for [naive] + candidates run as one
+        decorrelated batch — through the resilient wrapper, so a tunnel
+        flake mid-verdict retries the batch instead of killing the run."""
+        times = resilient.benchmark_batch_times(
+            [naive_seq] + list(seqs), bopts, seed=seed)
+        results = [BenchResult.from_times(ts) for ts in times]
+        paired = [paired_speedup(times[0], ts, seed=seed + 1) for ts in times[1:]]
+        return results, paired
+
+    def engine_of(seq) -> str:
+        names = [op.desc() for op in seq.vector()]
+        return "rdma" if any(".rdma" in n for n in names) else "host"
+
+    def label_of(s) -> str:
+        """'greedy-host-8l' for a labeled incumbent, 'climb/<engine>' for a
+        hill-climb candidate, 'mcts/<engine>' for an MCTS rollout — the
+        screen/final printouts must distinguish the entries they compare."""
+        base = incumbent_labels.get(id(s), "mcts")
+        if base in ("mcts", "climb", "climb-tip"):
+            return f"{base}/{engine_of(s.order)}"
+        return base
+
+    # distinct candidates by canonical key; heuristic incumbents always
+    # advance to screening (search-time noise must not knock them out).
+    # The mcts pool is the confirm-pass sims (re-measured at the same 10x
+    # floor the climbs use), but each pool is still sorted within itself and
+    # the screen slots interleave the pools: measurements taken minutes
+    # apart on a drifting chip are safer ranked per-pool than jointly.
+    from itertools import chain, zip_longest
+
+    seen = set()
+    cands = []
+    inc_ids = {id(s) for s in incumbents}
+    # screen-fidelity MCTS rollouts never advance directly: their ~1 ms-floor
+    # pct50s are not comparable with any other pool, and the confirm pass
+    # already re-measured the best of them at the climb floor
+    others = [s for s in res.sims
+              if id(s) not in inc_ids
+              and getattr(s, "fidelity", "full") == "full"]
+    pools = {
+        label: sorted(
+            (s for s in others if incumbent_labels.get(id(s), "mcts") == label),
+            key=lambda s: s.result.pct50,
+        )
+        for label in ("climb", "mcts")
+    }
+    interleaved = [
+        s
+        for pair in zip_longest(pools["climb"], pools["mcts"])
+        for s in pair
+        if s is not None
+    ]
+    for s in chain(incumbents, interleaved):
+        key = canonical_key(s.order)
+        if key not in seen:
+            seen.add(key)
+            cands.append(s)
+    # the screen needs room for searched candidates BEYOND the incumbent
+    # grid (7 labeled incumbents for halo) without shrinking the pool for
+    # workloads with few incumbents
+    cands = cands[: max(8, len(incumbents) + 4) if not args.smoke else 4]
+
+    vs = 1.0
+    value_us = naive.pct50 * 1e6
+    finals = []
+    top = []
+    if resilient.degraded:
+        # graceful degradation (docs/robustness.md): the device was lost
+        # mid-search and the run finished against cache + surrogate.  The
+        # paired screen/final need live hardware, and a verdict from
+        # predicted numbers must never pass as a measurement — report the
+        # pre-loss naive measurement with vs_baseline 1.0 and degraded
+        # provenance instead of a fabricated win.
+        sys.stderr.write(
+            "degraded: device lost mid-search — skipping the paired "
+            "screen/final; reporting no-win with degraded provenance\n")
+        cands = []
+    # constructed unconditionally: the regime metadata in the final JSON
+    # reads the ACTUAL floors these carry, so tuning a multiplier at one
+    # site cannot silently desynchronize the reported metadata
+    screen_opts = replace(opts, target_secs=5 * opts.target_secs)
+    fin_opts = replace(
+        opts, n_iters=3 * opts.n_iters, target_secs=20 * opts.target_secs
+    )
+    if cands:
+        for attempt in range(2):
+            t0 = time.time()
+            _, screen = batch_paired(
+                [s.order for s in cands], screen_opts, seed=1 + 10 * attempt
+            )
+            sys.stderr.write(
+                "screen (paired vs naive, wall %.0fs): %s\n"
+                % (
+                    time.time() - t0,
+                    ", ".join(
+                        "%s=%.4f" % (label_of(s), p[0])
+                        for s, p in zip(cands, screen)
+                    ),
+                )
+            )
+            # DEGENERATE-SCREEN guard: the tunnel has a slow regime in which
+            # every measurement is latency-dominated and all paired ratios
+            # collapse toward 1.0 (observed: a MoE screen ranking everything
+            # 0.95-1.05 minutes before the final batch measured the same
+            # candidates at 10.9-12.2x).  A screen is suspect only when it
+            # separates nothing (max ratio < 1.1) while the search-time
+            # medians PREDICTED real separation (naive vs best candidate
+            # >= 1.5x) — honest no-win workloads (SpMV ~1.0 everywhere)
+            # never trip it.  One re-run, then the measurement stands.
+            predicted = naive.pct50 / min(s.result.pct50 for s in cands)
+            best_screen = max(p[0] for p in screen)
+            # second clause added after r4w: a degraded chip regime flattened
+            # the whole screen to 1.02-1.18 while the search predicted 3.4x
+            # (the high-floor final then measured the survivors at 2.39x —
+            # but the RANKING had already been made under the flattened
+            # regime, advancing a 1.30 incumbent over stronger climbs)
+            degenerate = (best_screen < 1.1 and predicted > 1.5) or (
+                best_screen < 1.25 and predicted > 1.8
+            )
+            if not degenerate or attempt == 1:
+                break
+            sys.stderr.write(
+                f"screen degenerate (best ratio {best_screen:.2f}, search "
+                f"predicted {predicted:.2f}x) — re-running once\n"
+            )
+        ranked = sorted(
+            zip(cands, screen), key=lambda sp: sp[1][0], reverse=True
+        )
+        # only candidates that beat naive under the paired screen advance —
+        # the final batch reports no sub-1.0 losers
+        top = [s for s, p in ranked if p[0] > 1.0][:3]
+    if top:
+        t0 = time.time()
+        finals, paired = batch_paired([s.order for s in top], fin_opts, seed=3)
+        fin_naive, fin_cands = finals[0], finals[1:]
+        sys.stderr.write(
+            "final batch (wall %.0fs): naive=%.1fus candidates=[%s]us\n"
+            % (
+                time.time() - t0,
+                fin_naive.pct50 * 1e6,
+                ", ".join("%.1f" % (r.pct50 * 1e6) for r in fin_cands),
+            )
+        )
+        best_i = max(range(len(paired)), key=lambda i: paired[i][0])
+        m, lo, hi = paired[best_i]
+        sys.stderr.write(
+            "paired speedup vs naive: best=%.4f [%.4f, %.4f] 95%% CI "
+            "(all: %s)\n"
+            % (
+                m, lo, hi,
+                ", ".join(
+                    "%s=%.4f [%.4f, %.4f]" % (label_of(s), p[0], p[1], p[2])
+                    for s, p in zip(top, paired)
+                ),
+            )
+        )
+        # a win requires the bootstrap CI to exclude 1.0, not just the bare
+        # median — otherwise sampling noise reports a spurious speedup on
+        # roughly half of no-difference runs
+        if m > 1.0 and lo > 1.0:
+            value_us = fin_cands[best_i].pct50 * 1e6
+            vs = m
+        else:
+            value_us = fin_naive.pct50 * 1e6
+            vs = 1.0
+
+    # result-integrity gate (docs/robustness.md, "Schedule soundness"): the
+    # schedule whose number the JSON is about to report re-executes on the
+    # device next to naive, and their outputs must numerically agree — plus
+    # the independent verifier must pass it.  A fast-but-WRONG schedule
+    # (an under-synchronized winner whose race made it fast) can therefore
+    # never be the answer: a failed gate demotes the run to no-win and
+    # stamps ``verified: false`` with the verdict into the fault meta.
+    integrity = None
+    if verifier is not None and not resilient.degraded:
+        winner_seq = (top[best_i].order if top and finals and vs > 1.0
+                      else naive_seq)
+        verdict = verifier(winner_seq)
+        num_ok = False
+        gate_err = None
+        try:
+            import numpy as _np
+
+            from tenzing_tpu.fault.backoff import (
+                BackoffPolicy as _GP,
+                retry_call as _gate_retry,
+            )
+
+            t0 = time.time()
+            # transient-classified retry (default retry_on), like every
+            # other device interaction: one tunnel flake must not demote a
+            # multi-hour search's legitimate winner to verified: false
+            out_w = _gate_retry(lambda: ex.run(winner_seq),
+                                policy=_GP(retries=2, base_secs=2.0),
+                                where="verify.gate")
+            out_n = (out_w if winner_seq is naive_seq
+                     else _gate_retry(lambda: ex.run(naive_seq),
+                                      policy=_GP(retries=2, base_secs=2.0),
+                                      where="verify.gate"))
+            num_ok = True
+            mismatched = []
+            for name in sorted(set(out_n) & set(out_w)):
+                import jax as _jax
+
+                a = _np.asarray(_jax.device_get(out_n[name]),
+                                dtype=_np.float64)
+                b = _np.asarray(_jax.device_get(out_w[name]),
+                                dtype=_np.float64)
+                if a.shape != b.shape or not _np.allclose(
+                        a, b, rtol=args.verify_tol,
+                        atol=args.verify_tol * 1e-3, equal_nan=True):
+                    num_ok = False
+                    mismatched.append(name)
+            if mismatched:
+                gate_err = f"outputs diverge on {mismatched[:4]}"
+            sys.stderr.write(
+                "integrity gate: winner-vs-naive outputs "
+                f"{'agree' if num_ok else 'DIVERGE'}, verifier "
+                f"{'ok' if verdict.ok else 'UNSOUND'} "
+                f"(wall {time.time()-t0:.0f}s)\n")
+        except Exception as e:
+            gate_err = f"{type(e).__name__}: {str(e)[:200]}"
+            sys.stderr.write(
+                f"integrity gate: winner re-execution failed ({gate_err})\n")
+        integrity = {"verified": bool(verdict.ok and num_ok)}
+        if not verdict.ok:
+            integrity["verdict"] = verdict.witness()
+        if gate_err is not None:
+            integrity["error"] = gate_err
+        if not integrity["verified"] and vs > 1.0:
+            sys.stderr.write(
+                "integrity gate FAILED — demoting the winner to no-win\n")
+            value_us = (finals[0].pct50 if finals else naive.pct50) * 1e6
+            vs = 1.0
+    elif verifier is not None:
+        # degraded: no device to re-execute on — the answer is explicitly
+        # NOT verified (and already demoted to the pre-loss naive number)
+        integrity = {"verified": False, "error": "degraded: no device"}
+
+    # attribution profiling (docs/observability.md, "Attribution"): per-op
+    # stepped timing of the schedule whose number the JSON reports, plus
+    # naive for the decision diff — the attrib block is the measurement
+    # substrate the mega-kernel and chunking work will be judged with
+    # (dispatch overhead removed, which ops fail to overlap).
+    attrib_block = None
+    if args.profile_winner and resilient.degraded:
+        sys.stderr.write("profile-winner: skipped (device lost — no "
+                         "hardware to step ops on)\n")
+    elif args.profile_winner:
+        import os as _os
+
+        t0 = time.time()
+        try:
+            from tenzing_tpu.obs import attrib as _attrib
+
+            winner_seq_p = (top[best_i].order if top and finals and vs > 1.0
+                            else naive_seq)
+            cost = workload_cost(args.workload, built)
+            naive_meas_us = (finals[0].pct50 if finals else naive.pct50) * 1e6
+            w_tl = _attrib.stepped_timeline(ex, winner_seq_p,
+                                            repeats=args.profile_repeats)
+            w_at = _attrib.analyze(winner_seq_p.vector(), w_tl,
+                                   measured_us=value_us, cost=cost)
+            attrib_block = w_at.to_json()
+            expl = None
+            if winner_seq_p is not naive_seq:
+                n_tl = _attrib.stepped_timeline(ex, naive_seq,
+                                                repeats=args.profile_repeats)
+                n_at = _attrib.analyze(naive_seq.vector(), n_tl,
+                                       measured_us=naive_meas_us, cost=cost)
+                expl = _attrib.explain(naive_seq.vector(),
+                                       winner_seq_p.vector(),
+                                       naive_attrib=n_at,
+                                       winner_attrib=w_at)
+                attrib_block["explain"] = expl.get("timing", {})
+            # the winner's raw measurement series rides along for the
+            # report CLI's noise-aware regression check (obs/report.py)
+            fin_res = (finals[1 + best_i] if top and finals and vs > 1.0
+                       else (finals[0] if finals else naive))
+            if fin_res.times:
+                attrib_block["measured_times"] = [
+                    round(t, 9) for t in fin_res.times]
+            if args.trace_out:
+                _os.makedirs(args.trace_out, exist_ok=True)
+                doc = dict(expl) if expl is not None else {}
+                doc["attrib"] = attrib_block
+                _attrib.write_explain(
+                    _os.path.join(args.trace_out, "explain.json"), doc)
+                rank = obs.get_tracer().rank
+                # anchor the Gantt at the current unix-us instant so the
+                # per-lane tracks render next to the span timeline (span
+                # timestamps are unix-anchored, obs/tracer.py)
+                t0_us = time.time() * 1e6
+                attrib_extra.extend(_attrib.timeline_trace_events(
+                    w_at, pid=rank, t0_us=t0_us, label="attrib/winner"))
+                if expl is not None:
+                    attrib_extra.extend(_attrib.timeline_trace_events(
+                        n_at, pid=rank, t0_us=t0_us, label="attrib/naive",
+                        tid_base=2000))
+                sys.stderr.write(
+                    f"explain: {_os.path.join(args.trace_out, 'explain.json')}\n")
+            eff = attrib_block.get("overlap_efficiency")
+            sys.stderr.write(
+                "profile-winner: %d ops stepped, sum-of-parts %.1fus, "
+                "critical path %.1fus, dispatch overhead %.1fus, overlap "
+                "efficiency %s (wall %.0fs)\n"
+                % (attrib_block["n_timed"],
+                   attrib_block["sum_of_parts_us"],
+                   attrib_block["critical_path_us"],
+                   attrib_block["dispatch_overhead_us"],
+                   f"{eff:.3f}" if eff is not None else "n/a",
+                   time.time() - t0))
+        except Exception as e:
+            # profiling is observability, never a verdict gate: a stepped
+            # program that cannot compile (or a mesh platform) degrades to
+            # an error-carrying block instead of killing a finished search
+            sys.stderr.write(
+                f"profile-winner failed ({type(e).__name__}: "
+                f"{str(e)[:200]})\n")
+            attrib_block = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+    if args.dump_csv:
+        # One row per distinct schedule.  The decorrelated final-batch results
+        # *supersede* the search-time measurements for naive and the finalists
+        # (CsvBenchmarker returns the first equivalence match, so appending
+        # duplicate rows would leave the finals unreachable) — the headline
+        # verdict is replayable from the recorded database.
+        results = [naive] + [s.result for s in res.sims]
+        if finals:
+            results[0] = finals[0]
+            for r, s in zip(finals[1:], top):
+                # identity, not ==: sync ops compare kind-only, so two distinct
+                # schedules can be ==-equal and .index() would mis-attribute
+                idx = next(i for i, s2 in enumerate(res.sims) if s2 is s)
+                results[1 + idx] = r
+        orders = [naive_seq] + [s.order for s in res.sims]
+        # fidelity tags keep the DB honest: MCTS screen rows were measured at
+        # a ~1 ms floor and must not be ranked against full-floor rows by the
+        # warm-start loader (bench/recorded.py skips non-"full" rows)
+        fids = ["full"] + [getattr(s, "fidelity", "full") for s in res.sims]
+        if finals:
+            for s in top:
+                idx = next(i for i, s2 in enumerate(res.sims) if s2 is s)
+                fids[1 + idx] = "full"  # superseded by the final batch
+        # rows the learned screen answered from the MODEL carry no device
+        # measurement at all — tag them fid=model (inert to every reader,
+        # like screen rows) so the archive never passes predictions off as
+        # measurements
+        if surrogate is not None:
+            for i, s in enumerate(res.sims):
+                if fids[1 + i] == "screen" and search_bench.was_predicted(
+                        s.order):
+                    fids[1 + i] = "model"
+        # rows answered after device loss carry degraded provenance — like
+        # fid=model they are inert to every reader (CsvBenchmarker admits
+        # only "full" rows, recorded.py skips non-"full"), so a degraded
+        # run's archive can never pass predictions off as measurements
+        if resilient.degraded:
+            for i, s in enumerate(res.sims):
+                if resilient.was_degraded(s.order):
+                    fids[1 + i] = "degraded"
+        # screen rows cannot shadow full-fidelity twins on replay:
+        # CsvBenchmarker only admits "full" rows into its equivalence cache
+        rows = [
+            result_row(i, r, o, fidelity=None if f == "full" else f)
+            for i, (r, o, f) in enumerate(zip(results, orders, fids))
+        ]
+        # THE dump invariant every downstream reader trusts (recorded.py
+        # naive_anchor_of, learn/dataset.py): row 0 is the naive schedule at
+        # FINAL fidelity — checked at dump time (a real exception, not an
+        # assert: it must hold under python -O too) so a future reshuffle of
+        # the results list cannot silently poison every in-file ratio
+        # computed against this file's anchor
+        if orders[0] is not naive_seq or fids[0] != "full":
+            raise RuntimeError(
+                "dump-csv invariant violated: row 0 must be the naive "
+                "schedule at full fidelity")
+        with open(args.dump_csv, "w") as f:
+            f.write("\n".join(rows) + "\n")
+        sys.stderr.write(f"csv: {args.dump_csv} ({len(rows)} rows)\n")
+    # compile/perf provenance (ISSUE 5): "compiled programs: N" used to be
+    # a stderr-only note, so a compile-wall regression was invisible to the
+    # parsed BENCH_*.json series.  Close the prefetcher first (joins the
+    # background workers — no leaked threads — and finalizes the wasted
+    # tally), then stamp the pipeline economics into the JSON.
+    if prefetcher is not None:
+        prefetcher.close()
+    perf = {
+        "compiled_programs": ex.compile_count,
+        "compile_secs": round(ex.compile_secs, 3),
+        "compile_cache_dir": compile_cache_dir,
+        "prefetch": (prefetcher.stats() if prefetcher is not None else
+                     {"workers": 0, "issued": 0, "hits": 0, "wasted": 0,
+                      "failed": 0, "surfaced": 0, "dropped": 0}),
+    }
+    # regime metadata (VERDICT r4 item 6): cross-round vs_baseline
+    # comparisons need the chip regime (naive_us), the measurement floors
+    # that produced the verdict, and the warm-start provenance — without
+    # them the parsed series quietly compares different machines
+    meta = {
+        "perf": perf,
+        "naive_us": round(
+            (finals[0].pct50 if finals else naive.pct50) * 1e6, 2),
+        "search_floor_s": search_opts.target_secs,
+        "screen_floor_s": screen_opts.target_secs,
+        "final_floor_s": fin_opts.target_secs,
+        "mcts_screen_floor_s": mcts_screen.target_secs,
+        "winner_label": (label_of(top[best_i])
+                         if top and finals and vs > 1.0 else None),
+        "recorded_seeds": len(recorded),
+    }
+    # attribution provenance (ISSUE 6): per-op timeline, critical path,
+    # dispatch overhead and overlap efficiency of the reported schedule —
+    # next to the fault/perf blocks, parsed by the report CLI
+    if attrib_block is not None:
+        meta["attrib"] = attrib_block
+    # fault-layer provenance (ISSUE 3): a degraded verdict or a quarantine
+    # -heavy run must be visible in the parsed metric series, not only in
+    # stderr.  ``resumed`` distinguishes a continued run's numbers (its
+    # search-phase measurements may predate the current chip regime).
+    # ``verified`` (ISSUE 4) is the result-integrity gate's stamp: the
+    # reported answer re-executed on device with outputs matching naive AND
+    # passed the independent soundness verifier.
+    injected: dict = {}
+    for inj in (injector, corrupt_injector):
+        if inj is not None:
+            for k, v in inj.injected.items():
+                if v:
+                    injected[k] = injected.get(k, 0) + v
+    if (resilient.degraded or len(quar) or args.resume or injected
+            or integrity is not None):
+        meta["fault"] = {
+            "degraded": resilient.degraded,
+            "quarantined": len(quar),
+            "resumed": bool(args.resume),
+            **({"injected": injected} if injected else {}),
+            **(integrity if integrity is not None else {}),
+        }
+    write_telemetry()
+    return DriverResult(verdict={
+        "metric": metric,
+        "value": round(value_us, 2),
+        "unit": "us",
+        "vs_baseline": round(vs, 4),
+        **meta,
+    })

@@ -42,15 +42,25 @@ def naive_anchor_of(path: str) -> Optional[float]:
         return None
 
 
-def rank_recorded(
-    paths: List[str], graph, topk: int, log=None
-) -> List[Tuple[Sequence, float]]:
-    """Top ``topk`` distinct recorded schedules across ``paths``, best-first
-    by in-file paired ratio.  Rows that don't resolve against ``graph`` are
-    skipped (strict=False); files without a naive anchor contribute nothing
-    (regime unknown)."""
-    scored: List[Tuple[float, Sequence]] = []
-    n_rows = n_skip = 0
+def scored_rows(
+    paths: List[str], graph, log=None
+) -> Tuple[List[Tuple[float, float, Sequence, str]], dict]:
+    """``(scored, stats)``: every admissible recorded row across
+    ``paths`` as ``(in-file ratio, pct50, sequence, source path)``,
+    best-ratio-first, plus ``{"files", "rows", "skipped"}`` counts.
+
+    THE admission rule — FULL-fidelity rows with a positive pct50 that
+    beat their own file's naive anchor — shared by the warm-start
+    loader (:func:`rank_recorded`) and the serving store's warm path
+    (serve/service.py), so the search's cross-run memory and the
+    serving corpus can never drift on which rows count.  A
+    multi-fidelity screen row's pct50 came from a far cheaper
+    measurement floor than the file's naive anchor, so its in-file
+    ratio is not a regime-honest score; rows that don't resolve against
+    ``graph`` are skipped (strict=False); files without a naive anchor
+    contribute nothing (regime unknown)."""
+    scored: List[Tuple[float, float, Sequence, str]] = []
+    n_files = n_rows = n_skip = 0
     for path in paths:
         try:
             anchor = naive_anchor_of(path)
@@ -60,25 +70,31 @@ def rank_recorded(
             if log:
                 log(f"recorded db: {path} unreadable ({e})")
             continue
+        n_files += 1
         n_rows += len(db.entries)
         n_skip += len(db.skipped)
         # parallel by construction (CsvBenchmarker appends both in one
         # block); fail loudly rather than mislabel rows "full"
         assert len(db.fidelities) == len(db.entries)
-        fids = db.fidelities
         if anchor is None:
             continue
-        for (seq, res), fid in zip(db.entries, fids):
-            # only FULL-fidelity rows that beat their own naive are worth
-            # carrying: a multi-fidelity screen row's pct50 came from a far
-            # cheaper measurement floor than the file's naive anchor, so its
-            # in-file ratio is not a regime-honest score
+        for (seq, res), fid in zip(db.entries, db.fidelities):
             if fid == "full" and res.pct50 > 0 and anchor / res.pct50 > 1.0:
-                scored.append((anchor / res.pct50, seq))
+                scored.append((anchor / res.pct50, res.pct50, seq, path))
     scored.sort(key=lambda e: -e[0])
+    return scored, {"files": n_files, "rows": n_rows, "skipped": n_skip}
+
+
+def rank_recorded(
+    paths: List[str], graph, topk: int, log=None
+) -> List[Tuple[Sequence, float]]:
+    """Top ``topk`` distinct recorded schedules across ``paths``, best-first
+    by in-file paired ratio (admission: :func:`scored_rows`)."""
+    scored, stats = scored_rows(paths, graph, log=log)
+    n_rows, n_skip = stats["rows"], stats["skipped"]
     seen: set = set()
     out: List[Tuple[Sequence, float]] = []
-    for ratio, seq in scored:
+    for ratio, _pct50, seq, _path in scored:
         if len(out) >= topk:
             break
         # dedup modulo redundant syncs — the same equivalence CsvBenchmarker

@@ -47,11 +47,13 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import tempfile
 from typing import Any, Dict, List, Optional, Tuple
 
 from tenzing_tpu.bench.benchmarker import BenchOpts, BenchResult
 from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.utils.atomic import atomic_dump_json  # noqa: F401 — re-export:
+# the raw helper was born here and grew callers (fault/quarantine.py,
+# historical imports); the one definition now lives in utils/atomic.py
 
 CHECKPOINT_VERSION = 1
 
@@ -69,36 +71,13 @@ def _digest(payload_text: str) -> str:
     return hashlib.sha256(payload_text.encode()).hexdigest()
 
 
-def atomic_dump_json(path: str, doc: Dict[str, Any],
-                     prefix: str = ".ckpt.") -> None:
-    """THE raw atomic JSON write (tmp + fsync + rename) shared by every
-    fault-layer file writer (state snapshots here, fault/quarantine.py):
-    readers see either the previous complete file or the new complete
-    file, never a torn write, and the rename only lands after the bytes
-    are durably on disk."""
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(doc, f, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-
-
 def atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
     """Write ``payload`` as a versioned digest-checked envelope via
-    :func:`atomic_dump_json`."""
+    :func:`~tenzing_tpu.utils.atomic.atomic_dump_json`."""
     text = json.dumps(payload, sort_keys=True)
     atomic_dump_json(path, {"version": CHECKPOINT_VERSION,
-                            "digest": _digest(text), "payload": payload})
+                            "digest": _digest(text), "payload": payload},
+                     prefix=".ckpt.")
 
 
 def read_checked_json(path: str) -> Dict[str, Any]:

@@ -27,7 +27,7 @@ import os
 from typing import Dict, Optional
 
 from tenzing_tpu.bench.benchmarker import schedule_id
-from tenzing_tpu.fault.checkpoint import atomic_dump_json
+from tenzing_tpu.utils.atomic import atomic_dump_json
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.obs.tracer import get_tracer
 

@@ -30,6 +30,7 @@ from tenzing_tpu.learn.surrogate import (
     ScreeningBenchmarker,
     SurrogateBenchmarker,
 )
+from tenzing_tpu.learn.train import train_from_corpus
 
 __all__ = [
     "Corpus",
@@ -40,4 +41,5 @@ __all__ = [
     "SurrogateBenchmarker",
     "featurize",
     "spearman",
+    "train_from_corpus",
 ]

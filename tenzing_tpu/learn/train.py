@@ -1,0 +1,51 @@
+"""THE corpus→surrogate training recipe.
+
+One definition of ingestion → featurization → ridge-ensemble fit →
+in-sample Spearman, shared by the two callers that used to carry copies:
+``bench.py --learn-train`` (the driver's offline training branch,
+bench/driver.py) and the serving warm path
+(:meth:`~tenzing_tpu.serve.service.ScheduleService.warm` — the near
+tier's pricing model).  A change to the training contract (corpus
+admission, the min-rows threshold, the feature matrix call) lands in
+both paths by construction instead of diverging the CLI-trained and
+warm-trained surrogates.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from tenzing_tpu.learn.dataset import Corpus
+from tenzing_tpu.learn.features import FEATURE_NAMES
+from tenzing_tpu.learn.model import RidgeEnsemble, spearman
+
+# below this the bootstrap ensemble cannot even resample meaningfully —
+# a model "trained" on 2-3 rows would predict noise with false confidence
+MIN_TRAIN_ROWS = 4
+
+
+def train_from_corpus(
+    paths: List[str], graph, nbytes: Optional[Dict[str, int]] = None,
+    trace_paths: Optional[List[str]] = None, log=None,
+) -> Tuple[Optional[RidgeEnsemble], Dict[str, Any]]:
+    """``(model, info)`` from recorded search databases.
+
+    ``info`` always carries ``files``/``rows``; a corpus too small to
+    trust adds ``error`` and returns ``model=None``, otherwise ``info``
+    adds the in-sample ``train_spearman``.  ``nbytes`` must be the same
+    buffer-size map the caller will featurize with at predict time
+    (the train/serve feature contract, learn/features.py)."""
+    corpus = Corpus.from_files(paths, graph, log=log)
+    if trace_paths:
+        corpus.attach_traces(trace_paths, log=log)
+    info: Dict[str, Any] = {"files": len(paths), "rows": len(corpus.rows)}
+    if len(corpus.rows) < MIN_TRAIN_ROWS:
+        info["error"] = (
+            f"corpus too small to train (< {MIN_TRAIN_ROWS} rows)")
+        return None, info
+    X, y = corpus.matrices(nbytes=nbytes)
+    model = RidgeEnsemble(feature_names=list(FEATURE_NAMES))
+    model.fit(X, y)
+    pred, _ = model.predict(X)
+    info["train_spearman"] = round(spearman(pred, y), 4)
+    return model, info

@@ -22,7 +22,11 @@ Sections (each optional, driven by which inputs are given):
   spans by total duration), event counts;
 * ``--metrics GLOB`` — metrics-JSON histograms; summaries whose raw
   series was truncated (``truncated: true`` — obs/metrics.py) are labeled
-  **prefix-only** rather than passed off as full-series percentiles.
+  **prefix-only** rather than passed off as full-series percentiles;
+* ``--store PATH [--queue-dir DIR]`` — schedule-serving store mining
+  (docs/serving.md): records per workload/fingerprint, best stored
+  ``vs_naive``, refinement/unsound flags, tenants, and the cold-request
+  work-queue depth by reason.
 
 Regression check (``--check FRESH --baseline BASELINE [--tol T]``):
 compares two driver JSONs (raw driver lines or the ``{"parsed": ...}``
@@ -376,6 +380,71 @@ def metrics_section(paths: List[str], top: int = 12) -> List[str]:
     return lines
 
 
+# -- serving-store mining ---------------------------------------------------
+
+def store_section(store_paths: List[str],
+                  queue_dir: Optional[str] = None) -> List[str]:
+    """The schedule-serving store as a report section (docs/serving.md):
+    what the fleet can answer without a search, and what is queued."""
+    from tenzing_tpu.serve.store import ScheduleStore, WorkQueue
+
+    lines = ["## Schedule-serving stores", ""]
+    for path in store_paths:
+        store = None
+        if os.path.exists(path):
+            # read-only: quarantine_corrupt=False means an unreadable or
+            # version-mismatched file is reported but LEFT IN PLACE for
+            # the serving process to quarantine — a diagnostics command
+            # must never rename the store it was asked to describe
+            notes: List[str] = []
+            store = ScheduleStore(path, log=notes.append,
+                                  quarantine_corrupt=False)
+            if notes and len(store) == 0:
+                lines += [f"### `{path}`", "", notes[0], ""]
+                continue
+        if store is None or len(store) == 0:
+            lines += [f"### `{path}`", "", "empty or missing store", ""]
+            continue
+        lines += [f"### `{path}`", "",
+                  "| workload | fingerprint | schedules | best vs_naive | "
+                  "flagged | tenants |",
+                  "|---|---|---|---|---|---|"]
+        for exact in sorted(store.entries):
+            recs = list(store.entries[exact].values())
+            best = store.best(exact)
+            flagged = sum(1 for r in recs if any(r.get("flags", {}).values()))
+            tenants = sorted({r.get("provenance", {}).get("tenant", "?")
+                              for r in recs})
+            lines.append(
+                f"| {best.get('workload', '?')} | `{exact[:12]}` | "
+                f"{len(recs)} | {best.get('vs_naive', 0):.3f} | {flagged} | "
+                f"{', '.join(tenants)} |")
+        st = store.stats()
+        lines += ["",
+                  f"- records: {st['records']} across "
+                  f"{st['fingerprints']} fingerprint(s); "
+                  f"{st['flagged']} flagged; "
+                  f"{st['skipped_on_load']} skipped on load", ""]
+    if queue_dir is not None:
+        if not os.path.isdir(queue_dir):
+            # surface the operator error (a typo'd path) instead of
+            # silently creating it and reporting an empty queue
+            lines += [f"### work queue `{queue_dir}`", "",
+                      "missing directory", ""]
+            return lines
+        items = WorkQueue(queue_dir).items()
+        by_reason: Dict[str, int] = {}
+        for _, payload in items:
+            r = payload.get("reason", "?")
+            by_reason[r] = by_reason.get(r, 0) + 1
+        lines += [f"### work queue `{queue_dir}`", "",
+                  f"- depth: {len(items)}" +
+                  (" (" + ", ".join(f"{k}={v}" for k, v in
+                                    sorted(by_reason.items())) + ")"
+                   if by_reason else ""), ""]
+    return lines
+
+
 # -- CLI --------------------------------------------------------------------
 
 def _expand(globs: Optional[List[str]]) -> List[str]:
@@ -403,6 +472,9 @@ def build_report(args) -> Tuple[str, Optional[Dict[str, Any]]]:
     metrics = _expand(args.metrics)
     if metrics:
         lines += metrics_section(metrics)
+    stores = _expand(args.store)
+    if stores or args.queue_dir:
+        lines += store_section(stores, queue_dir=args.queue_dir)
     if args.check:
         fresh = load_driver_json(args.check)
         baseline = load_driver_json(args.baseline)
@@ -438,6 +510,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="telemetry JSONL bundles (bench.py --trace-out)")
     ap.add_argument("--metrics", nargs="*", default=None, metavar="GLOB",
                     help="metrics JSON files (bench.py --metrics-json)")
+    ap.add_argument("--store", nargs="*", default=None, metavar="PATH",
+                    help="schedule-serving store files "
+                         "(python -m tenzing_tpu.serve, docs/serving.md)")
+    ap.add_argument("--queue-dir", default=None, metavar="DIR",
+                    help="serving work-queue directory (cold/refinement "
+                         "depth by reason)")
     ap.add_argument("--check", default=None, metavar="FRESH",
                     help="fresh driver JSON for the regression check")
     ap.add_argument("--baseline", default=None, metavar="BASE",

@@ -1,0 +1,57 @@
+"""Schedule serving: the offline search corpus as a queryable service.
+
+The north-star is a fleet serving schedule requests for millions of users
+(ROADMAP, "Schedule-serving at fleet scale"); re-running a multi-hour
+search per request is not a serving path.  "Machine Learning for CUDA+MPI
+Design Rules" (PAPERS.md) reads a corpus of searched schedules as an
+asset — mineable to answer and prune future requests — and six rounds of
+searching left exactly that corpus on disk.  This package composes the
+existing offline pieces behind a request/response API:
+
+* :mod:`~tenzing_tpu.serve.fingerprint` — a stable workload fingerprint
+  (workload kind, shape bucket, mesh signature, engine kind-sets) with
+  power-of-two shape bucketing so nearby shapes share entries; schedule
+  keying via the existing ``canonical_key``.
+* :mod:`~tenzing_tpu.serve.store` — a persistent, schema-versioned,
+  multi-tenant schedule store (atomic writes via utils/atomic.py) with a
+  commutative, idempotent ``merge`` so stores from independent hosts/CI
+  runs combine without loss; plus the checkpointed cold-request
+  :class:`~tenzing_tpu.serve.store.WorkQueue`.
+* :mod:`~tenzing_tpu.serve.resolver` — tiered resolution: **exact** hits
+  answer instantly from the store (re-verified through
+  :class:`~tenzing_tpu.verify.ScheduleVerifier`, zero compiles, zero
+  measurements), **near** misses answer from the PR-2 surrogate under an
+  uncertainty gate with ``was_predicted`` provenance, **cold** requests
+  enqueue a :class:`~tenzing_tpu.bench.driver.DriverRequest` work item a
+  driver drains.
+* :mod:`~tenzing_tpu.serve.service` — the in-process API and the
+  ``python -m tenzing_tpu.serve`` CLI (``warm`` / ``query`` / ``merge`` /
+  ``stats``).
+
+Workflow and formats: docs/serving.md.  Telemetry: ``serve.*`` counters
+(hit/near/cold), the ``serve.resolve_us`` latency histogram, and
+``serve.query`` spans (docs/observability.md).
+"""
+
+from tenzing_tpu.serve.fingerprint import (
+    WorkloadFingerprint,
+    fingerprint_of,
+    schedule_key,
+    shape_bucket,
+)
+from tenzing_tpu.serve.resolver import Resolution, Resolver
+from tenzing_tpu.serve.service import ScheduleService
+from tenzing_tpu.serve.store import ScheduleStore, WorkQueue, merge_records
+
+__all__ = [
+    "Resolution",
+    "Resolver",
+    "ScheduleService",
+    "ScheduleStore",
+    "WorkQueue",
+    "WorkloadFingerprint",
+    "fingerprint_of",
+    "merge_records",
+    "schedule_key",
+    "shape_bucket",
+]

@@ -1,0 +1,132 @@
+"""``python -m tenzing_tpu.serve`` — the schedule-serving CLI.
+
+Subcommands (docs/serving.md; each prints ONE JSON line on stdout, the
+same machine-readable discipline as the bench driver):
+
+* ``warm``  — mine recorded search databases into the store (and train
+  the near tier's surrogate):
+  ``python -m tenzing_tpu.serve warm --store S --workload halo
+  --csv 'experiments/halo_search_tpu_r[45]*.csv'``
+* ``query`` — resolve one request through the exact/near/cold tiers:
+  ``python -m tenzing_tpu.serve query --store S --workload halo
+  --queue QDIR``
+* ``merge`` — fold other stores in (commutative, lossless):
+  ``python -m tenzing_tpu.serve merge --store S --from OTHER.json``
+* ``stats`` — store/queue occupancy:
+  ``python -m tenzing_tpu.serve stats --store S --queue QDIR``
+
+Shape flags (``--halo-n`` / ``--m`` / ``--spmv-bw`` / ``--moe-tokens`` /
+``--lanes`` / ``--smoke``) mirror the bench CLI: a query is exactly a
+:class:`~tenzing_tpu.bench.driver.DriverRequest`, which is also what a
+cold query's work item serializes — ``bench.py`` and a queue drainer
+answer the same request the same way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tenzing_tpu.bench.driver import DriverRequest
+from tenzing_tpu.serve.service import ScheduleService
+
+
+def _request_of(args) -> DriverRequest:
+    return DriverRequest(
+        workload=args.workload, smoke=args.smoke, halo_n=args.halo_n,
+        m=args.m, spmv_bw=args.spmv_bw, moe_tokens=args.moe_tokens,
+        lanes=args.lanes)
+
+
+def _service_of(args) -> ScheduleService:
+    return ScheduleService(
+        args.store, queue_dir=args.queue, model_path=args.model,
+        tenant=args.tenant, verify=not getattr(args, "no_verify", False),
+        near_max_sigma=getattr(args, "near_max_sigma", 0.75),
+        log=lambda m: sys.stderr.write(m + "\n"))
+
+
+def _emit(doc) -> None:
+    sys.stdout.write(json.dumps(doc) + "\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tenzing_tpu.serve",
+        description="Schedule-serving store/resolver CLI (docs/serving.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--store", required=True,
+                       help="store JSON path (created on first flush)")
+        p.add_argument("--queue", default=None, metavar="DIR",
+                       help="cold/refinement work-queue directory")
+        p.add_argument("--model", default=None,
+                       help="surrogate model JSON (default: "
+                            "<store>.model.json)")
+        p.add_argument("--tenant", default="local",
+                       help="provenance tenant tag for records added "
+                            "through this process")
+
+    def request_flags(p):
+        p.add_argument("--workload",
+                       choices=("halo", "spmv", "attn", "moe"),
+                       default="halo")
+        p.add_argument("--smoke", action="store_true",
+                       help="the tiny CPU config's fingerprint")
+        p.add_argument("--halo-n", type=int, default=512)
+        p.add_argument("--m", type=int, default=None)
+        p.add_argument("--spmv-bw", type=int, default=None)
+        p.add_argument("--moe-tokens", type=int, default=8192)
+        p.add_argument("--lanes", type=int, default=None)
+
+    pw = sub.add_parser("warm", help="mine recorded corpora into the store")
+    common(pw)
+    request_flags(pw)
+    pw.add_argument("--csv", nargs="+", required=True, metavar="GLOB",
+                    help="recorded search databases (bench.py --dump-csv)")
+    pw.add_argument("--bench", nargs="*", default=None, metavar="GLOB",
+                    help="driver JSON verdicts to stamp as provenance")
+    pw.add_argument("--topk", type=int, default=3,
+                    help="distinct winners to store per warm")
+    pw.add_argument("--no-train", action="store_true",
+                    help="skip training the near-tier surrogate")
+
+    pq = sub.add_parser("query", help="resolve one request")
+    common(pq)
+    request_flags(pq)
+    pq.add_argument("--no-verify", action="store_true",
+                    help="skip exact-hit re-verification (not "
+                         "recommended; docs/serving.md)")
+    pq.add_argument("--near-max-sigma", type=float, default=0.75,
+                    help="near-miss uncertainty gate (log-space ensemble "
+                         "spread ceiling)")
+
+    pm = sub.add_parser("merge", help="merge other stores into --store")
+    common(pm)
+    pm.add_argument("--from", dest="from_stores", nargs="+", required=True,
+                    metavar="STORE", help="store files to fold in")
+
+    ps = sub.add_parser("stats", help="store/queue occupancy")
+    common(ps)
+
+    args = ap.parse_args(argv)
+    svc = _service_of(args)
+    if args.cmd == "warm":
+        _emit(svc.warm(_request_of(args), args.csv,
+                       bench_globs=args.bench, topk=args.topk,
+                       train=not args.no_train))
+    elif args.cmd == "query":
+        _emit(svc.query(_request_of(args)).to_json())
+    elif args.cmd == "merge":
+        out = [svc.merge(p) for p in args.from_stores]
+        _emit({"merged": out, "records": len(svc.store)})
+    elif args.cmd == "stats":
+        _emit(svc.stats())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,309 @@
+"""Tiered request resolution: exact hit / near miss / cold.
+
+The serving path's answer policy, in strictly-cheaper-first order
+(docs/serving.md):
+
+* **exact** — the store holds a schedule for the request's exact
+  fingerprint digest: deserialize it against the request's graph and
+  re-verify through the independent
+  :class:`~tenzing_tpu.verify.ScheduleVerifier` (the PR-4 pair of eyes —
+  a store poisoned by a bad merge or a stale graph variant must never
+  serve an under-synchronized schedule).  Zero compiles, zero
+  measurements: resolution never builds an executor, and the provenance
+  block says so explicitly.  An entry that fails re-verification is
+  flagged, *not served*, and resolution falls through.
+* **near** — no exact entry, but the bucket (same bucketed shape / mesh
+  / engines) has neighbors: answer with the best neighbor's schedule,
+  priced by the PR-2 surrogate under an **uncertainty gate** — a
+  prediction whose ensemble spread exceeds ``near_max_sigma`` (log
+  space) is not an answer, it is a guess, and the request falls through
+  to cold.  Served predictions carry ``was_predicted: true`` provenance
+  (the same honesty rule the learned screen's ``fid=model`` dump rows
+  follow: a prediction must never masquerade as a measurement), and the
+  request's fingerprint is enqueued for background refinement while the
+  answering entry is flagged ``needs_refinement``.
+* **cold** — nothing to answer from: enqueue a checkpointed
+  :class:`~tenzing_tpu.bench.driver.DriverRequest` work item
+  (serve/store.py ``WorkQueue``) for a driver to drain, and say so.
+
+Every resolution lands a ``serve.query`` span, a ``serve.<tier>``
+counter, and a ``serve.resolve_us`` latency observation
+(docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs.tracer import get_tracer
+from tenzing_tpu.serve.fingerprint import WorkloadFingerprint, fingerprint_of
+from tenzing_tpu.serve.store import Record, ScheduleStore, WorkQueue
+
+
+@dataclass
+class Resolution:
+    """One resolved request.  ``provenance`` always carries
+    ``compiles: 0`` / ``measurements: 0`` — the serving tiers never
+    touch an executor; a number in here is either a stored measurement
+    (exact) or an explicitly-marked prediction (near)."""
+
+    tier: str  # "exact" | "near" | "cold"
+    fingerprint: WorkloadFingerprint
+    record: Optional[Record] = None
+    sequence: Optional[Any] = None  # Sequence, resolved against the request
+    pct50_us: Optional[float] = None
+    vs_naive: Optional[float] = None
+    provenance: Dict[str, Any] = field(default_factory=dict)
+    work_item: Optional[str] = None  # cold: the queued item's path
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "tier": self.tier,
+            "fingerprint": self.fingerprint.to_json(),
+            "provenance": self.provenance,
+        }
+        if self.record is not None:
+            out["key"] = self.record["key"]
+            out["ops"] = self.record["ops"]
+        if self.pct50_us is not None:
+            out["pct50_us"] = self.pct50_us
+        if self.vs_naive is not None:
+            out["vs_naive"] = self.vs_naive
+        if self.work_item is not None:
+            out["work_item"] = self.work_item
+        return out
+
+
+class Resolver:
+    """The tier policy over one :class:`ScheduleStore` (see module
+    docstring).
+
+    ``model`` is a loaded :class:`~tenzing_tpu.learn.RidgeEnsemble` (the
+    PR-2 surrogate) — without one the near tier is disabled and bucket
+    neighbors fall through to cold: an unpriced neighbor is not an
+    answer.  ``graph_builder`` defaults to the driver's device-free
+    :func:`~tenzing_tpu.bench.driver.graph_for`; graphs/verifiers are
+    cached per exact digest because structurally-identical requests
+    dominate serving traffic."""
+
+    def __init__(self, store: ScheduleStore, queue: Optional[WorkQueue] = None,
+                 model=None, near_max_sigma: float = 0.75,
+                 verify: bool = True,
+                 graph_builder: Optional[Callable] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self.store = store
+        self.queue = queue
+        self.model = model
+        self.near_max_sigma = float(near_max_sigma)
+        self.verify = verify
+        self._graph_builder = graph_builder
+        # per-exact-digest caches, BOUNDED: the digests are derived from
+        # client-controlled shape parameters, and a long-lived server
+        # sweeping shapes (one graph + verifier + surrogate each) must
+        # not grow without limit — insertion-order eviction is enough
+        # because serving traffic concentrates on few fingerprints
+        self.cache_cap = 32
+        self._graphs: Dict[str, Tuple[Any, Dict[str, int]]] = {}
+        self._verifiers: Dict[str, Any] = {}
+        # (model, surrogate) per exact digest: the surrogate's
+        # canonical-key prediction cache must survive across queries of
+        # a hot fingerprint (re-featurizing the same neighbors per
+        # request is O(schedule length) on the serve.resolve_us path);
+        # keyed with the model so a retrain invalidates
+        self._surrogates: Dict[str, Tuple[Any, Any]] = {}
+        self._log = log
+
+    def _note(self, msg: str) -> None:
+        if self._log is not None:
+            self._log(msg)
+
+    def _cache_put(self, cache: Dict[str, Any], key: str, value) -> None:
+        while len(cache) >= self.cache_cap:
+            cache.pop(next(iter(cache)))  # oldest insertion
+        cache[key] = value
+
+    def _graph(self, req, fp: WorkloadFingerprint):
+        got = self._graphs.get(fp.exact_digest)
+        if got is None:
+            builder = self._graph_builder
+            if builder is None:
+                from tenzing_tpu.bench.driver import graph_for as builder
+            got = builder(req)
+            self._cache_put(self._graphs, fp.exact_digest, got)
+        return got
+
+    def _verifier(self, graph, fp: WorkloadFingerprint):
+        v = self._verifiers.get(fp.exact_digest)
+        if v is None:
+            from tenzing_tpu.verify import ScheduleVerifier
+
+            v = ScheduleVerifier(graph)
+            self._cache_put(self._verifiers, fp.exact_digest, v)
+        return v
+
+    def _materialize(self, rec: Record, graph) -> Optional[Any]:
+        """The record's ops resolved against the *request's* graph; None
+        when they no longer resolve (recorded against a different
+        structural variant) — a store answer the request cannot execute
+        is no answer."""
+        from tenzing_tpu.core.serdes import sequence_from_json
+
+        try:
+            return sequence_from_json(rec["ops"], graph)
+        except Exception as e:
+            self._note(f"serve: record {rec['key'][:8]} does not resolve "
+                       f"({type(e).__name__}: {str(e)[:120]})")
+            return None
+
+    # -- tiers ---------------------------------------------------------------
+    def _try_exact(self, req, fp: WorkloadFingerprint) -> Optional[Resolution]:
+        records = self.store.exact_records(fp.exact_digest)
+        if not records:
+            return None
+        graph, _ = self._graph(req, fp)
+        # best-first WALK, not best-only: one unsound or unresolvable
+        # best record must not permanently block a sound runner-up under
+        # the same exact digest (the near tier excludes the requester's
+        # own digest, so falling through here would skip it entirely)
+        for rec in records:
+            seq = self._materialize(rec, graph)
+            if seq is None:
+                continue
+            verified = None
+            if self.verify:
+                verdict = self._verifier(graph, fp)(seq)
+                verified = bool(verdict.ok)
+                if not verified:
+                    # an unsound stored schedule must never be served —
+                    # flag it (visible in stats + the report CLI) and
+                    # try the next-best record
+                    self.store.flag(rec["exact"], rec["key"],
+                                    unsound=True, needs_refinement=True)
+                    get_metrics().counter("serve.store.unsound").inc()
+                    self._note(f"serve: exact entry {rec['key'][:8]} "
+                               "failed re-verification — flagged, "
+                               "not served")
+                    continue
+            prov = {
+                "verified": verified,
+                "was_predicted": False,
+                "compiles": 0,
+                "measurements": 0,
+                "source_exact": rec["exact"],
+                **rec.get("provenance", {}),
+            }
+            return Resolution(tier="exact", fingerprint=fp, record=rec,
+                              sequence=seq, pct50_us=rec.get("pct50_us"),
+                              vs_naive=rec.get("vs_naive"),
+                              provenance=prov)
+        return None
+
+    def _try_near(self, req, fp: WorkloadFingerprint) -> Optional[Resolution]:
+        if self.model is None:
+            return None
+        neighbors = self.store.bucket_records(
+            fp.bucket_digest, exclude_exact=fp.exact_digest)
+        if not neighbors:
+            return None
+        graph, nbytes = self._graph(req, fp)
+        ent = self._surrogates.get(fp.exact_digest)
+        if ent is None or ent[0] is not self.model:
+            from tenzing_tpu.learn import SurrogateBenchmarker
+
+            surrogate = SurrogateBenchmarker(self.model, nbytes=nbytes)
+            self._cache_put(self._surrogates, fp.exact_digest,
+                            (self.model, surrogate))
+        else:
+            surrogate = ent[1]
+        for rec in neighbors:
+            seq = self._materialize(rec, graph)
+            if seq is None:
+                continue
+            mu, sigma = surrogate.predict(seq)
+            if sigma > self.near_max_sigma:
+                # uncertainty gate: the ensemble cannot price this
+                # schedule for the requested shape — falling through to
+                # cold is honest, serving a wide guess is not
+                get_metrics().counter("serve.near_rejected").inc()
+                self._note(f"serve: near candidate {rec['key'][:8]} "
+                           f"rejected (sigma {sigma:.3f} > "
+                           f"{self.near_max_sigma})")
+                continue
+            verified = None
+            if self.verify:
+                verified = bool(self._verifier(graph, fp)(seq).ok)
+                if not verified:
+                    # same treatment as the exact tier: counted, flagged
+                    # for refinement, never served — a poisoned entry
+                    # first discovered via a near miss must not be
+                    # invisible to the serve.store.unsound dashboards
+                    self.store.flag(rec["exact"], rec["key"],
+                                    unsound=True, needs_refinement=True)
+                    get_metrics().counter("serve.store.unsound").inc()
+                    self._note(f"serve: near candidate {rec['key'][:8]} "
+                               "failed re-verification — flagged, "
+                               "not served")
+                    continue
+            # the label space is log(t / naive anchor): exp(-mu) is the
+            # predicted paired ratio vs naive for the requested shape
+            pred_vs = math.exp(-mu)
+            self.store.flag(rec["exact"], rec["key"], needs_refinement=True)
+            if self.queue is not None:
+                # ensure, not enqueue: a hot near-miss fingerprint
+                # re-resolves per request and must not rewrite an
+                # identical work item each time (same reasoning as
+                # flag()'s unchanged-short-circuit above)
+                self.queue.ensure(fp, self._request_payload(req),
+                                  reason="refine-near-miss")
+            prov = {
+                "verified": verified,
+                "was_predicted": True,
+                "uncertainty": round(float(sigma), 4),
+                "compiles": 0,
+                "measurements": 0,
+                "source_exact": rec["exact"],
+                "neighbor_vs_naive": rec.get("vs_naive"),
+                **rec.get("provenance", {}),
+            }
+            return Resolution(tier="near", fingerprint=fp, record=rec,
+                              sequence=seq, pct50_us=None,
+                              vs_naive=round(pred_vs, 4), provenance=prov)
+        return None
+
+    def _cold(self, req, fp: WorkloadFingerprint) -> Resolution:
+        path = None
+        if self.queue is not None:
+            path = self.queue.ensure(fp, self._request_payload(req),
+                                     reason="cold")
+        return Resolution(
+            tier="cold", fingerprint=fp, work_item=path,
+            provenance={"was_predicted": False, "compiles": 0,
+                        "measurements": 0})
+
+    @staticmethod
+    def _request_payload(req) -> Dict[str, Any]:
+        fn = getattr(req, "to_json", None)
+        return fn() if callable(fn) else dict(vars(req))
+
+    # -- entry ---------------------------------------------------------------
+    def resolve(self, req) -> Resolution:
+        """Resolve a :class:`~tenzing_tpu.bench.driver.DriverRequest`
+        through the tiers."""
+        reg = get_metrics()
+        tr = get_tracer()
+        t0 = time.perf_counter()
+        fp = fingerprint_of(req)
+        with tr.span("serve.query", workload=fp.workload,
+                     exact=fp.exact_digest, bucket=fp.bucket_digest) as sp:
+            res = (self._try_exact(req, fp)
+                   or self._try_near(req, fp)
+                   or self._cold(req, fp))
+            sp.set("tier", res.tier)
+        reg.counter(f"serve.{res.tier}").inc()
+        reg.histogram("serve.resolve_us").observe(
+            (time.perf_counter() - t0) * 1e6)
+        return res

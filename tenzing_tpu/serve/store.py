@@ -1,0 +1,464 @@
+"""The persistent schedule store + the cold-request work queue.
+
+**Store file** (one JSON document, written atomically via
+utils/atomic.py — the same tmp+fsync+rename discipline as the checkpoint
+state and the quarantine, docs/serving.md):
+
+``{"version": 1, "entries": {<exact-digest>: {<schedule-key>: record}}}``
+
+A **record** is schema-versioned (``"schema"``) and carries everything a
+resolution needs without re-deriving: the fingerprint document, the
+winning sequence's serialized ops, its measured ``pct50_us`` and
+``vs_naive`` (the in-file paired ratio against the corpus's own naive
+anchor — regime-honest, bench/recorded.py), a provenance block (tenant,
+source file, fidelity), the sha256 digests of the source corpus files,
+and mutable ``flags`` (e.g. ``needs_refinement``, stamped by the
+resolver's near-miss tier).
+
+**Merge** is commutative and idempotent by construction: records union
+by ``(exact-digest, schedule-key)``; a conflict resolves by a *total
+order* on records (higher ``vs_naive``, then lower ``pct50_us``, then
+the lexicographically larger canonical serialization — no tie can
+survive), while ``sources`` union and ``flags`` OR sticky.  Stores
+warmed on independent hosts/CI runs therefore combine without loss in
+either merge order (tests/test_serve_store.py asserts commutativity and
+idempotence literally).
+
+**Durability**: loads tolerate damage the way the quarantine does — a
+corrupt store file is *quarantined* (renamed to ``<path>.corrupt-<id>``)
+and reported, never fatal, and never silently clobbered by the next
+flush (read-only callers pass ``quarantine_corrupt=False`` to report
+without renaming); an individual record that fails validation is
+skipped with a note.  ``flush()`` re-reads the file and merges before
+writing, the whole read-merge-rename serialized under an advisory
+``flock`` on ``<path>.lock`` — concurrent writers, interleaved or
+simultaneous, land a merged superset.
+
+**Schema evolution**: ``RECORD_SCHEMA`` stamps every record;
+:func:`migrate_record` upgrades older schemas in place on load (schema 1
+predates ``sources``/``flags``), and a record from a *newer* schema than
+this code is skipped loudly rather than mis-read.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tenzing_tpu.fault.checkpoint import atomic_write_json, read_checked_json
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs.tracer import get_tracer, short_digest
+from tenzing_tpu.utils.atomic import atomic_dump_json
+
+STORE_VERSION = 1
+RECORD_SCHEMA = 2
+
+Record = Dict[str, Any]
+
+
+def file_digest(path: str) -> str:
+    """sha256 hex of a source corpus file — the provenance link from a
+    store record back to the bytes it was mined from."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def migrate_record(rec: Record) -> Optional[Record]:
+    """Upgrade ``rec`` to :data:`RECORD_SCHEMA`; None when it cannot be
+    trusted (newer schema, or missing the identity fields no default can
+    supply)."""
+    if not isinstance(rec, dict):
+        return None
+    schema = rec.get("schema", 1)
+    if schema > RECORD_SCHEMA:
+        return None
+    for key in ("exact", "bucket", "key", "ops", "workload"):
+        if key not in rec:
+            return None
+    out = dict(rec)
+    if schema < 2:
+        # schema 1 predates multi-tenant merge provenance
+        out.setdefault("sources", [])
+        out.setdefault("flags", {})
+        out.setdefault("provenance", {})
+    out["schema"] = RECORD_SCHEMA
+    return out
+
+
+def _order_key(rec: Record) -> Tuple:
+    """The total order merge resolves conflicts by: best record wins,
+    deterministically in either merge order."""
+    return (
+        float(rec.get("vs_naive") or 0.0),
+        -float(rec.get("pct50_us") or float("inf")),
+        # canonical serialization as the final tiebreak: NO pair of
+        # distinct records compares equal, so max() is order-independent
+        json.dumps(rec, sort_keys=True),
+    )
+
+
+def merge_records(a: Record, b: Record) -> Record:
+    """One (exact, key) slot's merge: the better record by
+    :func:`_order_key`, with ``sources`` unioned, ``flags`` ORed sticky
+    (a refinement flag set by either tenant survives), and provenance
+    keys the winner lacks filled from the loser — a driver-verdict stamp
+    (service.py ``warm --bench``) must survive merging with an unstamped
+    twin of the same schedule.  Winner precedence keeps this commutative:
+    which record is "winner" depends only on the pair, not the order."""
+    win, lose = (a, b) if _order_key(a) >= _order_key(b) else (b, a)
+    winner = dict(win)
+    winner["provenance"] = {**lose.get("provenance", {}),
+                            **win.get("provenance", {})}
+    winner["sources"] = sorted(
+        set(a.get("sources", [])) | set(b.get("sources", [])))
+    flags: Dict[str, bool] = {}
+    for src in (a.get("flags", {}), b.get("flags", {})):
+        for k, v in src.items():
+            # boolean OR — commutative by construction, so merge order
+            # cannot change the outcome (flags are sticky booleans)
+            flags[k] = bool(flags.get(k, False) or v)
+    winner["flags"] = dict(sorted(flags.items()))
+    return winner
+
+
+class ScheduleStore:
+    """In-memory store view, optionally file-backed (see module
+    docstring).  ``tenant`` stamps the provenance of records added
+    through this instance; merged records keep their original tenants."""
+
+    def __init__(self, path: Optional[str] = None, tenant: str = "local",
+                 log: Optional[Callable[[str], None]] = None,
+                 quarantine_corrupt: bool = True,
+                 _count_metrics: bool = True):
+        self.path = path
+        self.tenant = tenant
+        self._log = log
+        # False = read-only callers (the report CLI): an unreadable file
+        # is reported but LEFT IN PLACE — renaming evidence aside is the
+        # serving process's prerogative, not a diagnostics command's
+        self.quarantine_corrupt = quarantine_corrupt
+        # False = flush()'s throwaway re-read: bookkeeping, not a real
+        # load — counting it would inflate serve.store.loaded by the
+        # full record count on every flush
+        self._count_metrics = _count_metrics
+        self.entries: Dict[str, Dict[str, Record]] = {}
+        self.skipped = 0  # records dropped by validation/migration on load
+        if path is not None and os.path.exists(path):
+            self._load(path)
+
+    # -- loading ------------------------------------------------------------
+    def _note(self, msg: str) -> None:
+        if self._log is not None:
+            self._log(msg)
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if doc.get("version") != STORE_VERSION:
+                raise ValueError(
+                    f"store version {doc.get('version')!r} != "
+                    f"{STORE_VERSION}")
+            entries = doc["entries"]
+            if not isinstance(entries, dict):
+                raise ValueError("entries is not an object")
+        except Exception as e:
+            if not self.quarantine_corrupt:
+                self._note(f"store: unreadable {path} "
+                           f"({type(e).__name__}: {e}); left in place")
+                return
+            # quarantine, don't clobber: the damaged bytes move aside for
+            # post-mortem and the next flush starts a fresh file — losing
+            # a store to corruption is recoverable (re-warm), silently
+            # overwriting evidence is not
+            quarantined = f"{path}.corrupt-{short_digest(str(e))[:8]}"
+            try:
+                os.replace(path, quarantined)
+                self._note(f"store: quarantined unreadable {path} -> "
+                           f"{quarantined} ({type(e).__name__}: {e})")
+            except OSError:
+                self._note(f"store: unreadable {path} "
+                           f"({type(e).__name__}: {e})")
+            get_metrics().counter("serve.store.quarantined").inc()
+            return
+        n = 0
+        for exact, by_key in entries.items():
+            if not isinstance(by_key, dict):
+                # structurally malformed slot (valid JSON, wrong shape):
+                # skip it like a bad record — construction must stay
+                # never-fatal so flush()'s re-read (under the flock),
+                # the CLI, and the report all survive a damaged file
+                self.skipped += 1
+                self._note(f"store: skipped malformed slot {exact[:8]}")
+                continue
+            for key, rec in by_key.items():
+                mig = migrate_record(rec)
+                if mig is None:
+                    self.skipped += 1
+                    schema = (rec.get("schema")
+                              if isinstance(rec, dict) else type(rec).__name__)
+                    self._note(f"store: skipped record {exact[:8]}/{key[:8]} "
+                               f"(schema {schema!r})")
+                    continue
+                self._put(mig)
+                n += 1
+        if self._count_metrics:
+            get_metrics().counter("serve.store.loaded").inc(n)
+
+    # -- writing ------------------------------------------------------------
+    def _put(self, rec: Record) -> Record:
+        slot = self.entries.setdefault(rec["exact"], {})
+        prev = slot.get(rec["key"])
+        slot[rec["key"]] = rec if prev is None else merge_records(prev, rec)
+        return slot[rec["key"]]
+
+    def add(self, fingerprint, seq, pct50_us: float, vs_naive: float,
+            source: Optional[str] = None, fidelity: str = "full",
+            extra_provenance: Optional[Dict[str, Any]] = None) -> Record:
+        """Record ``seq`` (a Sequence) as a winner for ``fingerprint``.
+        ``source`` is the corpus file it was mined from (digested into
+        ``sources``)."""
+        from tenzing_tpu.bench.benchmarker import schedule_id
+        from tenzing_tpu.core.serdes import sequence_to_json
+        from tenzing_tpu.serve.fingerprint import schedule_key
+
+        prov: Dict[str, Any] = {"tenant": self.tenant, "fid": fidelity}
+        if source is not None:
+            prov["source"] = os.path.basename(source)
+        if extra_provenance:
+            prov.update(extra_provenance)
+        rec: Record = {
+            "schema": RECORD_SCHEMA,
+            "workload": fingerprint.workload,
+            "exact": fingerprint.exact_digest,
+            "bucket": fingerprint.bucket_digest,
+            "fingerprint": fingerprint.to_json(),
+            "key": schedule_key(seq),
+            "sid": schedule_id(seq),
+            "ops": sequence_to_json(seq),
+            "pct50_us": float(pct50_us),
+            "vs_naive": float(vs_naive),
+            "provenance": prov,
+            "sources": ([file_digest(source)]
+                        if source is not None and os.path.exists(source)
+                        else []),
+            "flags": {},
+        }
+        get_metrics().counter("serve.store.added").inc()
+        return self._put(rec)
+
+    def flag(self, exact: str, key: str, **flags: Any) -> None:
+        """Set sticky flags on a record (e.g. ``needs_refinement=True``
+        from the resolver's near-miss tier) and persist — but only when
+        something actually changed: a hot near-tier fingerprint re-flags
+        on every query, and an already-set flag must not pay the full
+        read-merge-fsync-rename cycle per request."""
+        rec = self.entries.get(exact, {}).get(key)
+        if rec is None:
+            return
+        cur = rec.setdefault("flags", {})
+        if all(cur.get(k) == v for k, v in flags.items()):
+            return
+        cur.update(flags)
+        self.flush()
+
+    # -- queries ------------------------------------------------------------
+    def __len__(self) -> int:
+        return sum(len(v) for v in self.entries.values())
+
+    def records(self) -> List[Record]:
+        return [r for by_key in self.entries.values()
+                for r in by_key.values()]
+
+    def best(self, exact: str) -> Optional[Record]:
+        """The best record for an exact fingerprint digest, by the same
+        total order merge resolves with — resolution and merge can never
+        disagree about which schedule a fingerprint serves."""
+        slot = self.entries.get(exact)
+        if not slot:
+            return None
+        return max(slot.values(), key=_order_key)
+
+    def exact_records(self, exact: str) -> List[Record]:
+        """ALL records under an exact digest, best-first — the exact
+        tier walks this so one unsound/unresolvable best record cannot
+        permanently block a sound runner-up (resolver.py)."""
+        slot = self.entries.get(exact)
+        if not slot:
+            return []
+        return sorted(slot.values(), key=_order_key, reverse=True)
+
+    def bucket_records(self, bucket: str,
+                       exclude_exact: Optional[str] = None) -> List[Record]:
+        """All records in a fingerprint bucket (the near-miss
+        neighborhood), best-first, optionally excluding one exact
+        digest (the requester's own)."""
+        out = [r for r in self.records()
+               if r.get("bucket") == bucket
+               and (exclude_exact is None or r["exact"] != exclude_exact)]
+        out.sort(key=_order_key, reverse=True)
+        return out
+
+    # -- merge / persistence ------------------------------------------------
+    def merge_from(self, other: "ScheduleStore") -> int:
+        """Merge another store's records into this one (see module
+        docstring for the algebra); returns how many records were
+        examined."""
+        n = 0
+        for rec in other.records():
+            self._put(dict(rec))
+            n += 1
+        get_metrics().counter("serve.store.merged").inc(n)
+        return n
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"version": STORE_VERSION, "entries": self.entries}
+
+    def flush(self) -> None:
+        """Persist: re-read the file, merge (another writer may have
+        flushed since our load), write atomically — the whole
+        read-merge-rename held under an advisory ``flock`` on a sidecar
+        ``<path>.lock`` so two *simultaneous* writers serialize instead
+        of racing (without the lock, both could re-read the same disk
+        state and the second rename would drop the first's records).
+        The lock file is never renamed — flocking the store file itself
+        would be defeated by the atomic-replace.  On platforms without
+        ``fcntl`` the merge-on-flush still protects interleaved (
+        non-simultaneous) writers."""
+        if self.path is None:
+            return
+        # the CLI promises "created on first flush": the directory must
+        # exist before the .lock sidecar opens (atomic_dump_json would
+        # create it, but the lock comes first)
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        try:
+            import fcntl
+        except ImportError:  # pragma: no cover — non-POSIX fallback
+            fcntl = None
+        lock_f = None
+        try:
+            if fcntl is not None:
+                lock_f = open(self.path + ".lock", "w")
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+            if os.path.exists(self.path):
+                # uncounted throwaway read + plain re-puts: this is
+                # flush bookkeeping, not a real load or merge — the
+                # documented store-economics counters must not grow
+                # with flush count
+                disk = ScheduleStore(self.path, tenant=self.tenant,
+                                     log=self._log, _count_metrics=False)
+                for rec in disk.records():
+                    self._put(dict(rec))
+            atomic_dump_json(self.path, self.to_json(), prefix=".store.")
+        finally:
+            if lock_f is not None:
+                lock_f.close()  # releases the flock
+        get_metrics().counter("serve.store.flushed").inc()
+
+    def stats(self) -> Dict[str, Any]:
+        by_wl: Dict[str, int] = {}
+        flagged = 0
+        tenants = set()
+        for rec in self.records():
+            by_wl[rec.get("workload", "?")] = \
+                by_wl.get(rec.get("workload", "?"), 0) + 1
+            if any(rec.get("flags", {}).values()):
+                flagged += 1
+            t = rec.get("provenance", {}).get("tenant")
+            if t:
+                tenants.add(t)
+        return {
+            "path": self.path,
+            "fingerprints": len(self.entries),
+            "records": len(self),
+            "by_workload": dict(sorted(by_wl.items())),
+            "flagged": flagged,
+            "tenants": sorted(tenants),
+            "skipped_on_load": self.skipped,
+        }
+
+
+class WorkQueue:
+    """The cold-request queue: one checkpointed work item per missing
+    fingerprint, written in the fault/checkpoint.py envelope format
+    (``atomic_write_json`` — versioned, sha256-digest-checked) so a
+    drainer validates an item with the same ``read_checked_json`` the
+    resume path trusts.  The payload is a serialized
+    :class:`~tenzing_tpu.bench.driver.DriverRequest`:
+    ``run(DriverRequest(**item["request"]))`` IS the drain step, and the
+    suggested ``checkpoint`` directory makes the search itself
+    kill-resumable.  Item filenames key on the exact fingerprint digest,
+    so re-querying a cold fingerprint re-asserts one item instead of
+    piling duplicates."""
+
+    def __init__(self, directory: str):
+        # the directory is created on first enqueue, NOT here: read-only
+        # callers (serve stats/query before anything is queued, the
+        # report CLI) must not silently materialize a typo'd --queue
+        # path and then report an empty queue where the real one lives
+        # elsewhere
+        self.dir = directory
+
+    def path_for(self, exact: str) -> str:
+        return os.path.join(self.dir, f"work-{exact}.json")
+
+    def ensure(self, fingerprint, request: Dict[str, Any],
+               reason: str) -> str:
+        """:meth:`enqueue` only when no valid item already exists for
+        this fingerprint — the hot-path variant (the near tier
+        re-resolves a popular fingerprint at fleet rates, and an
+        identical re-write would pay json+sha256+fsync+rename per
+        request); an existing-but-unreadable item IS rewritten."""
+        path = self.path_for(fingerprint.exact_digest)
+        if os.path.exists(path):
+            try:
+                read_checked_json(path)
+                return path
+            except Exception:
+                pass  # torn/corrupt item: re-assert it below
+        return self.enqueue(fingerprint, request, reason)
+
+    def enqueue(self, fingerprint, request: Dict[str, Any],
+                reason: str) -> str:
+        os.makedirs(self.dir, exist_ok=True)
+        path = self.path_for(fingerprint.exact_digest)
+        atomic_write_json(path, {
+            "kind": "search_request",
+            "reason": reason,
+            "fingerprint": fingerprint.to_json(),
+            "request": request,
+            "checkpoint": os.path.join(
+                self.dir, f"ckpt-{fingerprint.exact_digest}"),
+        })
+        get_metrics().counter("serve.queue.enqueued").inc()
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event("serve.enqueue", exact=fingerprint.exact_digest,
+                     reason=reason, workload=fingerprint.workload)
+        return path
+
+    def items(self) -> List[Tuple[str, Dict[str, Any]]]:
+        """(path, payload) per valid queued item; invalid files are
+        skipped (a drainer must never crash on one torn item), and a
+        not-yet-created queue directory is simply empty."""
+        out = []
+        if not os.path.isdir(self.dir):
+            return out
+        for name in sorted(os.listdir(self.dir)):
+            if not (name.startswith("work-") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                out.append((path, read_checked_json(path)))
+            except Exception:
+                continue
+        return out
+
+    def __len__(self) -> int:
+        return len(self.items())

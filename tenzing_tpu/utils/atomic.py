@@ -1,0 +1,42 @@
+"""THE atomic file-write helper (tmp + fsync + rename).
+
+One definition shared by every persistent-state writer in the tree — the
+checkpoint state snapshots (fault/checkpoint.py), the quarantine file
+(fault/quarantine.py), and the schedule-serving store/work-queue
+(serve/store.py).  Readers see either the previous complete file or the
+new complete file, never a torn write, and the rename only lands after
+the bytes are durably on disk.  Factored out of fault/checkpoint.py
+(where it was born) when the serving store would otherwise have grown a
+third copy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict
+
+
+def atomic_dump_json(path: str, doc: Dict[str, Any],
+                     prefix: str = ".atomic.") -> None:
+    """Atomically write ``doc`` as sorted-key JSON to ``path``.
+
+    The temp file is created in the destination directory (rename must not
+    cross filesystems), fsynced before the rename, and unlinked on any
+    failure so aborted writes leave no droppings."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

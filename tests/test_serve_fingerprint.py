@@ -1,0 +1,155 @@
+"""Fingerprint stability + bucketing goldens (ISSUE 7 satellite).
+
+The serving key space only works if the same workload configuration
+yields byte-identical fingerprints across process restarts, hosts, and
+argument orderings — otherwise independently-warmed stores fragment
+instead of merging.  These tests pin:
+
+* the power-of-two bucket boundaries with golden cases (a silent rule
+  change would re-shard every store in the fleet);
+* digest independence from construction order and ``PYTHONHASHSEED``
+  (the restart case, asserted across real subprocesses);
+* the exact/bucket digest relationships the resolver's tiers key on;
+* ``schedule_key``'s agreement with the repo-wide ``canonical_key``
+  equivalence (modulo redundant syncs).
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tenzing_tpu.bench.driver import DriverRequest
+from tenzing_tpu.serve.fingerprint import (
+    WorkloadFingerprint,
+    fingerprint_of,
+    schedule_key,
+    shape_bucket,
+)
+
+# golden bucket boundaries: 2^k stays, 2^k + 1 rounds up — pinned so a
+# bucketing change cannot land silently (it re-keys every store)
+BUCKET_GOLDENS = [
+    (0, 0), (-3, 0), (1, 1), (2, 2), (3, 4), (4, 4), (5, 8),
+    (7, 8), (8, 8), (9, 16), (300, 512), (511, 512), (512, 512),
+    (513, 1024), (1024, 1024), (1025, 2048), (150_000, 262_144),
+]
+
+
+def test_bucket_goldens():
+    for n, want in BUCKET_GOLDENS:
+        assert shape_bucket(n) == want, (n, shape_bucket(n), want)
+
+
+def test_bucket_is_idempotent():
+    # a bucket is its own bucket: re-fingerprinting a bucketed shape
+    # cannot drift to a different neighborhood
+    for n in (1, 2, 4, 64, 512, 4096):
+        assert shape_bucket(shape_bucket(n)) == shape_bucket(n)
+
+
+def test_field_order_cannot_leak_into_digest():
+    a = WorkloadFingerprint(
+        workload="spmv", variant="full",
+        shape=(("bw", 0), ("m", 512), ("nnz_per_row", 10)),
+        bucket=(("bw", 0), ("m", 512), ("nnz_per_row", 16)),
+        mesh=(("lanes", 2),),
+        engines=(("ici", ("a", "b")), ("pcie", ("c",))),
+    )
+    # same content reconstructed through to_json/from_json (dict-keyed,
+    # so any ordering the JSON round-trip imposes must not matter)
+    b = WorkloadFingerprint.from_json(a.to_json())
+    assert a == b
+    assert a.exact_digest == b.exact_digest
+    assert a.bucket_digest == b.bucket_digest
+
+
+def test_digest_stable_across_process_restarts():
+    """Byte-identical digests under different PYTHONHASHSEEDs — the
+    restart/fleet case: no Python hash() anywhere in the key path."""
+    prog = (
+        "from tenzing_tpu.bench.driver import DriverRequest\n"
+        "from tenzing_tpu.serve.fingerprint import fingerprint_of\n"
+        "f = fingerprint_of(DriverRequest(workload='spmv', m=512))\n"
+        "import sys\n"
+        "sys.stdout.write(f.exact_digest + ' ' + f.bucket_digest)\n"
+    )
+    import os
+    from pathlib import Path
+
+    repo = str(Path(__file__).resolve().parent.parent)
+    outs = set()
+    for seed in ("0", "1", "31337"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo)
+        r = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True,
+            env=env, cwd=repo, check=True)
+        outs.add(r.stdout.strip())
+    assert len(outs) == 1, outs
+    # and the in-process digests agree with the subprocess ones
+    f = fingerprint_of(DriverRequest(workload="spmv", m=512))
+    assert outs.pop() == f"{f.exact_digest} {f.bucket_digest}"
+
+
+def test_exact_vs_bucket_relationships():
+    base = fingerprint_of(DriverRequest(workload="spmv", m=512))
+    near = fingerprint_of(DriverRequest(workload="spmv", m=500))
+    far = fingerprint_of(DriverRequest(workload="spmv", m=100_000))
+    assert base.exact_digest != near.exact_digest
+    assert base.bucket_digest == near.bucket_digest  # the near-miss tier
+    assert base.bucket_digest != far.bucket_digest   # the cold tier
+    # workload, variant, and mesh all partition the key space
+    assert fingerprint_of(DriverRequest(workload="halo")).exact_digest \
+        != base.exact_digest
+    assert fingerprint_of(
+        DriverRequest(workload="spmv", m=512, smoke=True)).exact_digest \
+        != base.exact_digest
+    assert fingerprint_of(
+        DriverRequest(workload="spmv", m=512, lanes=4)).exact_digest \
+        != base.exact_digest
+
+
+def test_fingerprint_json_roundtrip_carries_digests():
+    f = fingerprint_of(DriverRequest(workload="attn", smoke=True))
+    j = f.to_json()
+    assert j["exact"] == f.exact_digest
+    assert j["bucket_digest"] == f.bucket_digest
+    assert WorkloadFingerprint.from_json(
+        json.loads(json.dumps(j))).exact_digest == f.exact_digest
+
+
+@pytest.fixture(scope="module")
+def spmv_graph():
+    from tenzing_tpu.bench.driver import graph_for
+
+    g, _ = graph_for(DriverRequest(workload="spmv", m=512))
+    return g
+
+
+def _drive(g, n_lanes, picks):
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import State
+
+    plat = Platform.make_n_lanes(n_lanes)
+    st = State(g)
+    i = 0
+    while not st.is_terminal():
+        ds = st.get_decisions(plat)
+        st = st.apply(ds[picks[i % len(picks)] % len(ds)])
+        i += 1
+    return st.sequence
+
+
+def test_schedule_key_matches_canonical_equivalence(spmv_graph):
+    a = _drive(spmv_graph, 2, [1, 2, 0])
+    b = _drive(spmv_graph, 2, [1, 2, 0])  # independently driven twin
+    c = _drive(spmv_graph, 2, [2, 1, 0])
+    assert schedule_key(a) == schedule_key(b)
+    assert schedule_key(a) != schedule_key(c)
+    # redundant-sync normalization is part of the key (the same
+    # equivalence CsvBenchmarker(normalize=True) answers under)
+    from tenzing_tpu.core.schedule import remove_redundant_syncs
+
+    assert schedule_key(a) == schedule_key(remove_redundant_syncs(a))
