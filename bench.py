@@ -157,6 +157,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="timed repeats per op in --profile-winner "
                          "stepped profiling (median minus calibrated "
                          "fetch overhead)")
+    ap.add_argument("--fuse-winner", action="store_true",
+                    help="megakernel fusion of the reported schedule "
+                         "(docs/performance.md, 'Megakernel fusion'): "
+                         "partition it into fusible regions, lower each "
+                         "into one Pallas kernel (runtime/fused.py), sweep "
+                         "the roofline-pruned tile menu, gate the fused "
+                         "outputs against the stepped program (allclose + "
+                         "re-verified), and stamp the ``perf.fused`` block "
+                         "(regions, tiles, dispatch overhead before/after)")
     ap.add_argument("--no-verify", action="store_true",
                     help="disable the independent schedule-soundness "
                          "verifier (docs/robustness.md): the guard in the "

@@ -130,6 +130,7 @@ class DriverRequest:
     inject_hang_secs: float = 60.0
     profile_winner: bool = False
     profile_repeats: int = 7
+    fuse_winner: bool = False
     no_verify: bool = False
     verify_tol: float = 0.02
 
@@ -478,6 +479,26 @@ def graph_for(req: DriverRequest):
         g.then_finish(op)
         return g, {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
     raise DriverConfigError(f"unknown workload {w!r}")
+
+
+def _mismatched_outputs(out_a, out_b, tol: float) -> List[str]:
+    """THE numeric-agreement policy of the result-integrity gate: names
+    (shared by both output dicts) whose arrays differ in shape or fail
+    ``allclose(rtol=tol, atol=tol*1e-3, equal_nan=True)`` in float64.
+    Used by the winner-vs-naive gate and the fused-vs-stepped gate — one
+    copy, so a tolerance or NaN-policy change cannot split their
+    semantics."""
+    import jax as _jax
+    import numpy as _np
+
+    mismatched = []
+    for name in sorted(set(out_a) & set(out_b)):
+        a = _np.asarray(_jax.device_get(out_a[name]), dtype=_np.float64)
+        b = _np.asarray(_jax.device_get(out_b[name]), dtype=_np.float64)
+        if a.shape != b.shape or not _np.allclose(
+                a, b, rtol=tol, atol=tol * 1e-3, equal_nan=True):
+            mismatched.append(name)
+    return mismatched
 
 
 class _RunScope:
@@ -1584,6 +1605,10 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
     # never be the answer: a failed gate demotes the run to no-win and
     # stamps ``verified: false`` with the verdict into the fault meta.
     integrity = None
+    # gate outputs stashed for reuse: the fused phase compares against the
+    # stepped program's outputs, which the gate just computed — re-running
+    # a multi-GB workload's program for the same answer is pure waste
+    gate_outs: Dict[int, Dict[str, Any]] = {}
     if verifier is not None and not resilient.degraded:
         winner_seq = (top[best_i].order if top and finals and vs > 1.0
                       else naive_seq)
@@ -1591,8 +1616,6 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
         num_ok = False
         gate_err = None
         try:
-            import numpy as _np
-
             from tenzing_tpu.fault.backoff import (
                 BackoffPolicy as _GP,
                 retry_call as _gate_retry,
@@ -1609,20 +1632,10 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
                      else _gate_retry(lambda: ex.run(naive_seq),
                                       policy=_GP(retries=2, base_secs=2.0),
                                       where="verify.gate"))
-            num_ok = True
-            mismatched = []
-            for name in sorted(set(out_n) & set(out_w)):
-                import jax as _jax
-
-                a = _np.asarray(_jax.device_get(out_n[name]),
-                                dtype=_np.float64)
-                b = _np.asarray(_jax.device_get(out_w[name]),
-                                dtype=_np.float64)
-                if a.shape != b.shape or not _np.allclose(
-                        a, b, rtol=args.verify_tol,
-                        atol=args.verify_tol * 1e-3, equal_nan=True):
-                    num_ok = False
-                    mismatched.append(name)
+            gate_outs[id(winner_seq)] = out_w
+            gate_outs[id(naive_seq)] = out_n
+            mismatched = _mismatched_outputs(out_n, out_w, args.verify_tol)
+            num_ok = not mismatched
             if mismatched:
                 gate_err = f"outputs diverge on {mismatched[:4]}"
             sys.stderr.write(
@@ -1649,12 +1662,18 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
         # NOT verified (and already demoted to the pre-loss naive number)
         integrity = {"verified": False, "error": "degraded: no device"}
 
+    # the schedule whose number the JSON reports, AFTER any gate demotion —
+    # the one object the profiling and fusion phases both operate on
+    reported_seq = (top[best_i].order if top and finals and vs > 1.0
+                    else naive_seq)
+
     # attribution profiling (docs/observability.md, "Attribution"): per-op
     # stepped timing of the schedule whose number the JSON reports, plus
     # naive for the decision diff — the attrib block is the measurement
     # substrate the mega-kernel and chunking work will be judged with
     # (dispatch overhead removed, which ops fail to overlap).
     attrib_block = None
+    profiled_attrib = None
     if args.profile_winner and resilient.degraded:
         sys.stderr.write("profile-winner: skipped (device lost — no "
                          "hardware to step ops on)\n")
@@ -1665,14 +1684,18 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
         try:
             from tenzing_tpu.obs import attrib as _attrib
 
-            winner_seq_p = (top[best_i].order if top and finals and vs > 1.0
-                            else naive_seq)
+            winner_seq_p = reported_seq
             cost = workload_cost(args.workload, built)
             naive_meas_us = (finals[0].pct50 if finals else naive.pct50) * 1e6
             w_tl = _attrib.stepped_timeline(ex, winner_seq_p,
                                             repeats=args.profile_repeats)
             w_at = _attrib.analyze(winner_seq_p.vector(), w_tl,
                                    measured_us=value_us, cost=cost)
+            # stash for the fusion phase: its "before" timeline is this
+            # exact (sequence, repeats, measured_us) analysis — with both
+            # --profile-winner and --fuse-winner set, re-stepping a
+            # multi-GB workload per op twice is minutes of pure waste
+            profiled_attrib = w_at
             attrib_block = w_at.to_json()
             expl = None
             if winner_seq_p is not naive_seq:
@@ -1730,6 +1753,125 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
                 f"profile-winner failed ({type(e).__name__}: "
                 f"{str(e)[:200]})\n")
             attrib_block = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+    # megakernel fusion (docs/performance.md, "Megakernel fusion"): lower
+    # the reported schedule into fused Pallas regions (runtime/fused.py),
+    # sweep the roofline-pruned tile menu, gate the best fused program
+    # through the result-integrity machinery (allclose vs the stepped
+    # program + re-verified), and stamp the ``perf.fused`` provenance
+    # block with the dispatch overhead before/after (obs/attrib) — the
+    # measured answer to "what did fusing the dispatches buy".
+    fused_block = None
+    if args.fuse_winner and resilient.degraded:
+        sys.stderr.write("fuse-winner: skipped (device lost — no hardware "
+                         "to run fused programs on)\n")
+        fused_block = {"error": "degraded: no device"}
+    elif args.fuse_winner:
+        t0 = time.time()
+        try:
+            from tenzing_tpu.obs import attrib as _attrib
+            from tenzing_tpu.runtime.fused import FusedExecutor, fused_summary
+
+            winner_seq_f = reported_seq
+            cost = workload_cost(args.workload, built)
+            # "before": the unfused program's dispatch overhead — per-op
+            # stepped sum-of-parts minus the reported whole-program pct50.
+            # --profile-winner already produced this exact analysis of the
+            # same sequence/repeats/measured_us: reuse it instead of
+            # re-stepping every op
+            if profiled_attrib is not None:
+                at_b = profiled_attrib
+            else:
+                tl_b = _attrib.stepped_timeline(ex, winner_seq_f,
+                                                repeats=args.profile_repeats)
+                at_b = _attrib.analyze(winner_seq_f.vector(), tl_b,
+                                       measured_us=value_us, cost=cost)
+            # compile tallies snapshot AFTER the stepped timeline: the
+            # per-op sub-program compiles above are attribution cost, not
+            # fusion cost — the stamped delta covers plan + tile variants
+            # + the gate's executions only
+            compile0, csecs0 = ex.compile_count, ex.compile_secs
+            plan0 = FusedExecutor(ex).plan(winner_seq_f)
+            menu = plan0.tile_menu
+            by_tiles: Dict[str, float] = {}
+            best_t, best_us, best_fex = 1, None, None
+            for t in menu:
+                # fresh benchmarker per variant: the shared CachingBenchmarker
+                # keys by canonical schedule, which would collide the fused
+                # variants with the stepped measurement of the same order
+                fex_t = FusedExecutor(ex, tiles=t)
+                res_t = EmpiricalBenchmarker(fex_t).benchmark(
+                    winner_seq_f, opts)
+                us = res_t.pct50 * 1e6
+                by_tiles[str(t)] = round(us, 2)
+                if best_us is None or us < best_us:
+                    best_t, best_us, best_fex = t, us, fex_t
+            plan = best_fex.plan(winner_seq_f)
+            # result-integrity gate on the fused outputs: allclose vs the
+            # stepped program, and the schedule re-verified (PR 4 gate)
+            out_f = best_fex.run(winner_seq_f)
+            # the PR-4 gate already executed this exact sequence — reuse
+            # its outputs instead of re-running a potentially multi-GB
+            # program (gate skipped/failed -> fresh execution)
+            out_s = gate_outs.get(id(winner_seq_f))
+            if out_s is None:
+                out_s = ex.run(winner_seq_f)
+            mismatched = _mismatched_outputs(out_s, out_f, args.verify_tol)
+            num_ok = not mismatched
+            re_verdict = verifier(winner_seq_f) if verifier is not None \
+                else None
+            fused_verified = bool(
+                num_ok and (re_verdict.ok if re_verdict is not None
+                            else True))
+            # "after": the FUSED program's remaining dispatch overhead —
+            # one stepped unit per region instead of per op
+            fseq = best_fex.fused_order(winner_seq_f)
+            tl_a = _attrib.stepped_timeline(ex, fseq,
+                                            repeats=args.profile_repeats)
+            at_a = _attrib.analyze(fseq.vector(), tl_a,
+                                   measured_us=best_us, cost=cost)
+            fused_block = {
+                "regions": len(plan.regions),
+                "region_sizes": [r.n_ops for r in plan.regions],
+                "fused_ops": plan.n_ops_fused,
+                "n_ops_total": plan.n_ops_total,
+                "tiles": {"chosen": best_t, "menu": menu,
+                          "per_region": [r.tiles for r in plan.regions],
+                          "by_tiles_us": by_tiles},
+                "measured_us": {"stepped": round(value_us, 2),
+                                "fused": round(best_us, 2)},
+                "compile_secs": round(ex.compile_secs - csecs0, 3),
+                "compiled_programs": ex.compile_count - compile0,
+                "verified": fused_verified,
+                "dispatch_overhead_us": {
+                    "before": round(at_b.dispatch_overhead_us, 3),
+                    "after": round(at_a.dispatch_overhead_us, 3)},
+                "sum_of_parts_us": {
+                    "before": round(at_b.sum_of_parts_us, 3),
+                    "after": round(at_a.sum_of_parts_us, 3)},
+            }
+            if mismatched:
+                fused_block["error"] = \
+                    f"fused outputs diverge on {mismatched[:4]}"
+            if re_verdict is not None and not re_verdict.ok:
+                fused_block["verdict"] = re_verdict.witness()
+            sys.stderr.write(
+                "fuse-winner: %s; tiles %s -> best t=%d %.1fus (stepped "
+                "%.1fus); dispatch overhead %.1f -> %.1fus; %s (wall "
+                "%.0fs)\n" % (
+                    fused_summary(plan), by_tiles, best_t, best_us,
+                    value_us,
+                    fused_block["dispatch_overhead_us"]["before"],
+                    fused_block["dispatch_overhead_us"]["after"],
+                    "verified" if fused_verified else "GATE FAILED",
+                    time.time() - t0))
+        except Exception as e:
+            # like profiling, fusion provenance must never kill a finished
+            # search — an error-carrying block instead
+            sys.stderr.write(
+                f"fuse-winner failed ({type(e).__name__}: "
+                f"{str(e)[:200]})\n")
+            fused_block = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
 
     if args.dump_csv:
         # One row per distinct schedule.  The decorrelated final-batch results
@@ -1805,6 +1947,10 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
                      {"workers": 0, "issued": 0, "hits": 0, "wasted": 0,
                       "failed": 0, "surfaced": 0, "dropped": 0}),
     }
+    # megakernel-fusion provenance (ISSUE 8): regions, tiles chosen, gate
+    # verdict, dispatch overhead before/after — present iff --fuse-winner
+    if fused_block is not None:
+        perf["fused"] = fused_block
     # regime metadata (VERDICT r4 item 6): cross-round vs_baseline
     # comparisons need the chip regime (naive_us), the measurement floors
     # that produced the verdict, and the warm-start provenance — without

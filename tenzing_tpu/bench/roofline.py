@@ -24,6 +24,16 @@ from typing import Dict
 V5E_PEAK_BF16_FLOPS = 197e12
 V5E_PEAK_HBM_BYTES = 819e9
 
+# On-core VMEM budget a fused-region tile's working set must fit (v5e has
+# 128 MiB of VMEM per core; leave headroom for Pallas double-buffering and
+# spills — the prune is a can-this-possibly-help filter, not a compiler)
+V5E_VMEM_BYTES = 96 * 2**20
+# Per-tile traffic floor below which the grid-step overhead (program
+# prologue, DMA issue latency) dominates any pipelining win a finer tiling
+# could buy — measured kernels in this repo stop scaling well under ~1 MiB
+# of traffic per grid step
+MIN_TILE_BYTES = 1 * 2**20
+
 
 @dataclass(frozen=True)
 class Cost:
@@ -88,6 +98,50 @@ def halo_cost(nq: int, lx: int, ly: int, lz: int, radius: int,
         hbm_bytes=4.0 * face_bytes,
         xfer_bytes=(2.0 * face_bytes if staged else 0.0),
     )
+
+
+def prune_tilings(cost: Cost, tile_counts, vmem_bytes: int = V5E_VMEM_BYTES,
+                  min_tile_bytes: int = MIN_TILE_BYTES,
+                  full_bytes: float = 0.0):
+    """Tile counts of a fused region (runtime/fused.py) that could possibly
+    help, from the structurally-valid candidates ``tile_counts``:
+
+    * ``t == 1`` (the un-tiled single-block kernel) always survives — it is
+      the fallback every region must admit;
+    * ``t > 1`` is dropped when the per-tile share of the TILED traffic
+      falls under ``min_tile_bytes`` (grid-step overhead dominates — a
+      finer tiling cannot help) or the per-tile working set exceeds
+      ``vmem_bytes`` (the tile cannot fit on-core, so the kernel would
+      spill or fail to compile — a coarser tiling is required, not this
+      one).
+
+    ``full_bytes`` is the traffic of the region's FULL-VIEW buffers (the
+    ``fuse_tiling`` entries declared ``None`` — e.g. a fused attention
+    fold's K/V block, or a gathered x): those are re-presented whole to
+    every grid step, so they do not shrink with ``t`` — the per-tile
+    working set is ``(hbm_bytes - full_bytes) / t + full_bytes``, not
+    ``hbm_bytes / t``.
+
+    This is the analytic can-it-help filter the tile *decision nodes*
+    (``FuseTileChoice``) are built from: the searchable menu is the pruned
+    set, so the solvers never spend measurements on tilings the roofline
+    already rules out.
+    """
+    full = min(max(0.0, float(full_bytes)), cost.hbm_bytes)
+    tiled_total = cost.hbm_bytes - full
+    out = []
+    for t in sorted({int(t) for t in tile_counts}):
+        if t < 1:
+            continue
+        if t == 1:
+            out.append(t)
+            continue
+        per_tile_tiled = tiled_total / t
+        working_set = per_tile_tiled + full
+        if per_tile_tiled < min_tile_bytes or working_set > vmem_bytes:
+            continue
+        out.append(t)
+    return out or [1]
 
 
 def spmv_cost(m: int, nnz: int, bytes_per_el: int = 4) -> Cost:

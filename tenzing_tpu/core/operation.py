@@ -216,6 +216,26 @@ class DeviceOp(OpBase):
     def bind(self, lane: Lane) -> "BoundDeviceOp":
         return BoundDeviceOp(self, lane)
 
+    # -- megakernel-fusion protocol (runtime/fused.py) ---------------------
+    def fusible(self) -> bool:
+        """True when ``apply`` may be traced INSIDE a Pallas kernel body:
+        pure buffer->buffer jax computation — no collectives (no mesh axis
+        context inside a kernel), no nested ``pallas_call``
+        (``uses_pallas`` ops are excluded by the partitioner regardless),
+        no host/transfer semantics.  Opt-in per op class: the fusion
+        backend only ever fuses ops that declare it, so an un-audited op
+        can never silently land inside a megakernel."""
+        return False
+
+    def fuse_tiling(self) -> Optional[Dict[str, Optional[int]]]:
+        """Row-decomposition declaration for fused-region tiling: a map
+        over this op's reads+writes of the axis along which the op is
+        independent (``None`` value = the op needs the FULL buffer, e.g.
+        a gathered x or the K/V block of an attention fold).  ``None``
+        return = not tileable; the op still fuses, but its region only
+        offers the trivial single-tile kernel."""
+        return None
+
 
 class BoundDeviceOp(BoundOp):
     """DeviceOp + Lane = executable (reference BoundGpuOp, ops_cuda.hpp:202-238).
@@ -262,6 +282,12 @@ class BoundDeviceOp(BoundOp):
 
     def uses_pallas(self) -> bool:
         return self._op.uses_pallas()
+
+    def fusible(self) -> bool:
+        return self._op.fusible()
+
+    def fuse_tiling(self) -> Optional[Dict[str, Optional[int]]]:
+        return self._op.fuse_tiling()
 
     def to_json(self) -> Dict[str, Any]:
         j = self._op.to_json()

@@ -100,6 +100,20 @@ class AttnStep(DeviceOp):
         )
         return {"acc": acc, "m_run": m, "l_run": l}
 
+    # megakernel fusion (runtime/fused.py): the online-softmax update is
+    # row-independent along the query axis (axis 1 of the (b, n, d) state);
+    # the K/V block being folded must stay whole.  The Pallas subclasses
+    # inherit this but are excluded by the partitioner's uses_pallas test
+    # (no nested kernels).
+    def fusible(self) -> bool:
+        return True
+
+    def fuse_tiling(self):
+        t = {"Q": 1, "acc": 1, "m_run": 1, "l_run": 1}
+        for n in self.reads():
+            t.setdefault(n, None)  # the K/V pair, whatever its names
+        return t
+
 
 class AttnStepPallas(AttnStep):
     """Same update via the Pallas MXU kernel (ops/attention_pallas.py)."""
@@ -193,6 +207,13 @@ class FinalizeAttn(DeviceOp):
 
     def apply(self, bufs, ctx):
         return {"O": bufs["acc"] / bufs["l_run"]}
+
+    # fusion: elementwise over the (b, n, d) state
+    def fusible(self) -> bool:
+        return True
+
+    def fuse_tiling(self):
+        return {"acc": 1, "l_run": 1, "O": 1}
 
 
 class RingAttention(CompoundOp):

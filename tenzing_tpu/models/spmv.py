@@ -291,6 +291,14 @@ class SpMVOp(DeviceOp):
         vals, cols, x = bufs[self._vals], bufs[self._cols], bufs[self._x]
         return {self._y: jnp.sum(vals * x[cols], axis=1)}
 
+    # megakernel fusion (runtime/fused.py): rows are independent — the slab
+    # and output decompose along axis 0; the gathered x must stay whole
+    def fusible(self) -> bool:
+        return True
+
+    def fuse_tiling(self):
+        return {self._vals: 0, self._cols: 0, self._y: 0, self._x: None}
+
 
 class SpMVPallasOp(SpMVOp):
     """ELL-slab SpMV via the Pallas masked vreg-gather kernel
@@ -354,6 +362,13 @@ class Scatter(DeviceOp):
     def apply(self, bufs, ctx):
         return {self._out: bufs[self._x][bufs[self._idx]]}
 
+    # fusion: each gathered entry depends only on its own index row
+    def fusible(self) -> bool:
+        return True
+
+    def fuse_tiling(self):
+        return {self._x: None, self._idx: 0, self._out: 0}
+
 
 class VectorAdd(DeviceOp):
     """y = yl + yr (reference VectorAdd — a no-op there,
@@ -371,6 +386,13 @@ class VectorAdd(DeviceOp):
 
     def apply(self, bufs, ctx):
         return {self._out: bufs[self._a] + bufs[self._b]}
+
+    # fusion: elementwise
+    def fusible(self) -> bool:
+        return True
+
+    def fuse_tiling(self):
+        return {self._a: 0, self._b: 0, self._out: 0}
 
 
 class LocalExchange(DeviceOp):
@@ -390,6 +412,13 @@ class LocalExchange(DeviceOp):
 
     def apply(self, bufs, ctx):
         return {self._dst: bufs[self._src]}
+
+    # fusion: a device-local copy, trivially row-independent
+    def fusible(self) -> bool:
+        return True
+
+    def fuse_tiling(self):
+        return {self._src: 0, self._dst: 0}
 
 
 class SpMVCompound(CompoundOp):

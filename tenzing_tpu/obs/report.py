@@ -211,6 +211,7 @@ def bench_section(paths: List[str]) -> List[str]:
              "compile (s) | prefetch hit/issued | quarantined | verified | "
              "overlap eff | dispatch ovh (us) |",
              "|---|---|---|---|---|---|---|---|---|---|---|"]
+    fused_lines: List[str] = []
     for path in paths:
         try:
             d = load_driver_json(path)
@@ -235,7 +236,31 @@ def bench_section(paths: List[str]) -> List[str]:
                 ok=("—" if ver is None else ver),
                 oe=at.get("overlap_efficiency", "—"),
                 do=at.get("dispatch_overhead_us", "—")))
+        fu = perf.get("fused")
+        if fu:
+            # megakernel-fusion economics (docs/performance.md): regions
+            # lowered, tile chosen, and the dispatch overhead the fused
+            # program removed vs its stepped twin
+            if "error" in fu and "regions" not in fu:
+                fused_lines.append(
+                    f"- `{os.path.basename(path)}`: fusion failed "
+                    f"({fu['error']})")
+                continue
+            do = fu.get("dispatch_overhead_us") or {}
+            before, after = do.get("before"), do.get("after")
+            removed = (f"{before - after:.1f}us removed "
+                       f"({before} -> {after})"
+                       if before is not None and after is not None else "—")
+            fused_lines.append(
+                f"- `{os.path.basename(path)}`: {fu.get('regions', 0)} "
+                f"region(s) over {fu.get('fused_ops', 0)}/"
+                f"{fu.get('n_ops_total', 0)} ops, tiles "
+                f"{(fu.get('tiles') or {}).get('chosen', 1)}, dispatch "
+                f"overhead {removed}, verified "
+                f"{fu.get('verified', False)}")
     lines.append("")
+    if fused_lines:
+        lines += ["### Megakernel fusion", ""] + fused_lines + [""]
     return lines
 
 

@@ -1,0 +1,63 @@
+"""Pallas version-compat shim: one import site absorbs jax API drift.
+
+The kernels in this package target the current Pallas TPU API, but the
+search/serving layers must keep working in containers pinned to older jax
+(the CI matrix and the measurement tunnels do not upgrade in lockstep).
+Two renames/additions broke every pallas-importing suite on jax 0.4.37:
+
+* ``jax.experimental.pallas.tpu.CompilerParams`` is ``TPUCompilerParams``
+  on older jax, and the older dataclass is missing fields newer kernels
+  pass (0.4.37 has no ``has_side_effects``).  :func:`compiler_params`
+  resolves the class once and **drops unknown kwargs** — the dropped
+  fields are compile-time hints (side-effect pinning, megacore grid
+  semantics) that only matter on a real TPU backend, which always ships a
+  matching jax; the older container only ever runs these kernels in the
+  Pallas interpreter, where the hints are inert anyway.
+* ``jax.typeof`` (the varying-across-mesh ``vma`` probe ``out_struct``
+  uses) does not exist on 0.4.37.  :func:`typeof` falls back to
+  ``jax.eval_shape``, whose ShapeDtypeStruct simply carries no ``vma``
+  attribute — matching the old behavior where shard_map had no varying
+  -axes check to satisfy.
+
+Everything else in the kernels (BlockSpec layout, scratch_shapes,
+``pl.when``) is stable across the supported range; add to this module
+rather than version-gating at kernel sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+def compiler_params_cls():
+    """The platform's Pallas TPU compiler-params class, whatever its name."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = getattr(pltpu, "TPUCompilerParams")
+    return cls
+
+
+def compiler_params(**kwargs: Any):
+    """A compiler-params instance, dropping kwargs the installed jax's class
+    does not know (see module docstring for why dropping is sound here)."""
+    cls = compiler_params_cls()
+    try:
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in kwargs.items() if k in known}
+    except TypeError:  # not a dataclass on some future jax: pass through
+        pass
+    return cls(**kwargs)
+
+
+def typeof(x):
+    """``jax.typeof(x)`` where it exists, else a ``jax.eval_shape`` struct
+    (no ``vma`` attribute — callers getattr with a default)."""
+    import jax
+
+    fn = getattr(jax, "typeof", None)
+    if fn is not None:
+        return fn(x)
+    return jax.eval_shape(lambda a: a, x)

@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tenzing_tpu.core.operation import BoundDeviceOp, OpBase
+from tenzing_tpu.core.operation import BoundDeviceOp, OpBase, unbound
 from tenzing_tpu.core.platform import Platform
 from tenzing_tpu.core.resources import Event, Lane
 from tenzing_tpu.core.sequence import Sequence
@@ -178,7 +178,38 @@ class TraceContext:
 
     def trace_default(self, op) -> None:
         """Trace a BoundOp: tie ONE of its reads to its chain token, apply,
-        chain the written values back into the token.
+        chain the written values back into the token."""
+        is_device = isinstance(op, BoundDeviceOp)
+        if is_device:
+            tok_in = self._join(self._lane(op.lane()), self._host_tok)
+        else:
+            tok_in = self._host_tok
+        tok_out = self._apply_op(op, tok_in)
+        if is_device:
+            self._lane_tok[op.lane().id] = tok_out
+        else:
+            self._host_tok = tok_out
+
+    def trace_fused(self, op) -> None:
+        """Trace a multi-lane fused-region op (runtime/fused.py): join EVERY
+        member lane's chain plus the host chain, tie one read, apply the
+        fused kernel, and advance ALL member lanes to the output token.
+
+        Advancing every lane makes the fused region a conservative barrier
+        across the lanes it absorbed — a strict superset of the ordering
+        the member ops had individually, so replacing them with the fused
+        op can never drop a happens-before edge (it can only add them; the
+        cost is overlap the megakernel now owns internally)."""
+        lanes = op.lanes()
+        tok_in = self._join(*[self._lane(l) for l in lanes], self._host_tok)
+        tok_out = self._apply_op(op, tok_in)
+        for l in lanes:
+            self._lane_tok[l.id] = tok_out
+
+    def _apply_op(self, op, tok_in):
+        """The shared tie-apply-writeback-join body of ``trace_default`` and
+        ``trace_fused``: returns the output token (callers route it into
+        the right chain(s)).
 
         One tied read is sufficient for the happens-before semantics — an op
         cannot start until EVERY input is ready, so making any one input
@@ -187,11 +218,6 @@ class TraceContext:
         whose consumer XLA cannot slice-fuse (measured on the halo flagship:
         tying the 2 GB grid U on every unpack added a full grid read+write
         per direction — ~30 ms/iter of pure tie overhead)."""
-        is_device = isinstance(op, BoundDeviceOp)
-        if is_device:
-            tok_in = self._join(self._lane(op.lane()), self._host_tok)
-        else:
-            tok_in = self._host_tok
         view = self.bufs
         # index-tie contract: an op declaring INDEX_TIE consumes
         # ``ctx.tok_index_zero`` (an int32 0 data-dependent on its token) in
@@ -228,11 +254,7 @@ class TraceContext:
             if name not in self.host_space
             for l in jax.tree_util.tree_leaves(val)
         ]
-        tok_out = self._join(tok_in, *[_clean(_scalarize(l)) for l in leaves])
-        if is_device:
-            self._lane_tok[op.lane().id] = tok_out
-        else:
-            self._host_tok = tok_out
+        return self._join(tok_in, *[_clean(_scalarize(l)) for l in leaves])
 
     # -- sync-op hooks (core/sync_ops.py) ----------------------------------
     def record_event(self, lane: Lane, event: Event) -> None:
@@ -251,6 +273,24 @@ class TraceContext:
 
     def wait_lane(self, waiter: Lane, waitee: Lane) -> None:
         self._lane_tok[waiter.id] = self._join(self._lane(waiter), self._lane(waitee))
+
+
+def evolve_host_space(names: set, op: OpBase) -> None:
+    """Apply ONE op's transfer semantics to the host-space name set, in
+    place: an op declaring ``DST_SPACE`` (ops/comm_ops.py) deterministically
+    moves its writes into ("host") or out of ("device") host memory; every
+    other op leaves the set untouched.  THE one copy of the space-evolution
+    rule — ``TraceExecutor._host_space_after`` folds it over a schedule and
+    the fusion partitioner (``runtime/fused.py::partition_regions``) steps
+    it op-by-op while cutting regions, so a new memory space or a changed
+    DST_SPACE convention lands in both or neither."""
+    dst_space = getattr(unbound(op), "DST_SPACE", None)
+    if dst_space is not None:
+        for w in op.writes():
+            if dst_space == "host":
+                names.add(w)
+            else:
+                names.discard(w)
 
 
 def _check_inflight_drained(tc: "TraceContext") -> None:
@@ -330,13 +370,7 @@ class TraceExecutor:
         move names between spaces deterministically via DST_SPACE)."""
         names = self._initial_host_space()
         for op in ops:
-            dst_space = getattr(op, "DST_SPACE", None)
-            if dst_space is not None:
-                for w in op.writes():
-                    if dst_space == "host":
-                        names.add(w)
-                    else:
-                        names.discard(w)
+            evolve_host_space(names, op)
         return names
 
     def _traced(self, ops: List[OpBase], bufs: Dict[str, Any]) -> Dict[str, Any]:
