@@ -411,7 +411,7 @@ def store_section(store_paths: List[str],
                   queue_dir: Optional[str] = None) -> List[str]:
     """The schedule-serving store as a report section (docs/serving.md):
     what the fleet can answer without a search, and what is queued."""
-    from tenzing_tpu.serve.store import ScheduleStore, WorkQueue
+    from tenzing_tpu.serve.store import ScheduleStore
 
     lines = ["## Schedule-serving stores", ""]
     for path in store_paths:
@@ -457,16 +457,111 @@ def store_section(store_paths: List[str],
             lines += [f"### work queue `{queue_dir}`", "",
                       "missing directory", ""]
             return lines
-        items = WorkQueue(queue_dir).items()
-        by_reason: Dict[str, int] = {}
-        for _, payload in items:
-            r = payload.get("reason", "?")
-            by_reason[r] = by_reason.get(r, 0) + 1
-        lines += [f"### work queue `{queue_dir}`", "",
-                  f"- depth: {len(items)}" +
-                  (" (" + ", ".join(f"{k}={v}" for k, v in
-                                    sorted(by_reason.items())) + ")"
-                   if by_reason else ""), ""]
+        lines += queue_section(queue_dir)
+    return lines
+
+
+def queue_section(queue_dir: str) -> List[str]:
+    """The drain-daemon view of one work queue (docs/serving.md "Drain
+    daemon"): depth by reason, the torn set (visible rot), live leases
+    with heartbeat staleness, the poison quarantine, each worker's
+    status JSON, and per-item drain economics mined from the status
+    histories + the ``ckpt-*`` checkpoint journals."""
+    import time as _time
+
+    from tenzing_tpu.serve.store import WorkQueue
+
+    q = WorkQueue(queue_dir)
+    items = q.items()
+    by_reason: Dict[str, int] = {}
+    for _, payload in items:
+        r = payload.get("reason", "?")
+        by_reason[r] = by_reason.get(r, 0) + 1
+    lines = [f"### work queue `{queue_dir}`", "",
+             f"- depth: {len(items)}" +
+             (" (" + ", ".join(f"{k}={v}" for k, v in
+                               sorted(by_reason.items())) + ")"
+              if by_reason else "")]
+    if q.torn_paths:
+        lines.append(
+            f"- torn items: {len(q.torn_paths)} (" +
+            ", ".join(f"`{os.path.basename(p)}`"
+                      for p in q.torn_paths) + ")")
+    leases = q.leases()
+    if leases:
+        lines += ["", "| lease | owner | heartbeat age (s) |", "|---|---|---|"]
+        for l in leases:
+            lines.append(f"| `{l['exact'][:12]}` | {l.get('owner', '?')} | "
+                         f"{l['age_s']:.1f} |")
+    poisoned = q.poisoned()
+    if poisoned:
+        lines += ["", "| poisoned | reason | attempts | last failure |",
+                  "|---|---|---|---|"]
+        for path, doc in poisoned:
+            atts = doc.get("attempts", [])
+            last = atts[-1] if atts else {}
+            lines.append(
+                f"| `{doc.get('exact', os.path.basename(path))[:12]}` | "
+                f"{doc.get('reason', '?')} | {len(atts)} | "
+                f"{last.get('error_class', '—')}: "
+                f"{(last.get('message') or '—')[:60]} |")
+    # daemon status documents: liveness + per-item drain economics
+    now = _time.time()
+    for name in sorted(os.listdir(queue_dir)):
+        if not (name.startswith("status-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(queue_dir, name)) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            lines += ["", f"- daemon `{name}`: unreadable"]
+            continue
+        c = st.get("counters", {})
+        stale = now - float(st.get("heartbeat_at", 0))
+        lines += ["",
+                  f"- daemon `{st.get('owner', name)}`: {st.get('state')}"
+                  f", heartbeat {stale:.1f}s ago — claimed "
+                  f"{c.get('claimed', 0)}, completed {c.get('completed', 0)}"
+                  f", retried {c.get('retried', 0)}, poisoned "
+                  f"{c.get('poisoned', 0)}, reclaimed "
+                  f"{c.get('reclaimed', 0)}"]
+        hist = st.get("history", [])
+        if hist:
+            lines += ["",
+                      "| item | outcome | wall (s) | attempts | "
+                      "journal replays | merged |", "|---|---|---|---|---|---|"]
+            for h in hist:
+                lines.append(
+                    f"| `{h.get('exact', '?')[:12]}` | {h.get('outcome')} | "
+                    f"{h.get('wall_s', 0):.1f} | {h.get('attempts', 1)} | "
+                    f"{h.get('journal_lines_prior', 0)} | "
+                    f"{h.get('merged', 0)} |")
+    # per-item checkpoint journals: what a re-drain would replay free
+    ckpts = sorted(n for n in os.listdir(queue_dir)
+                   if n.startswith("ckpt-")
+                   and os.path.isdir(os.path.join(queue_dir, n)))
+    econ = []
+    for n in ckpts:
+        jpath = os.path.join(queue_dir, n, "measurements.jsonl")
+        meas = batches = 0
+        if os.path.exists(jpath):
+            with open(jpath) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        j = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line
+                    if "batch" in j:
+                        batches += 1
+                    else:
+                        meas += 1
+        econ.append(f"`{n[5:17]}`: {meas} measurement(s), "
+                    f"{batches} batch(es)")
+    if econ:
+        lines += ["", "- checkpoint journals: " + "; ".join(econ)]
+    lines.append("")
     return lines
 
 

@@ -27,12 +27,19 @@ existing offline pieces behind a request/response API:
 * :mod:`~tenzing_tpu.serve.service` — the in-process API and the
   ``python -m tenzing_tpu.serve`` CLI (``warm`` / ``query`` / ``merge`` /
   ``stats``).
+* :mod:`~tenzing_tpu.serve.daemon` — the hardened drain daemon
+  (``python -m tenzing_tpu.serve.daemon``): leased claims over the work
+  queue, crash-resume through each item's checkpoint, bounded classified
+  retries, poison quarantine, status/heartbeat JSON — the
+  serve→search→serve loop closed end-to-end (docs/serving.md
+  "Drain daemon").
 
 Workflow and formats: docs/serving.md.  Telemetry: ``serve.*`` counters
 (hit/near/cold), the ``serve.resolve_us`` latency histogram, and
 ``serve.query`` spans (docs/observability.md).
 """
 
+from tenzing_tpu.serve.daemon import DaemonOpts, DrainDaemon
 from tenzing_tpu.serve.fingerprint import (
     WorkloadFingerprint,
     fingerprint_of,
@@ -44,6 +51,8 @@ from tenzing_tpu.serve.service import ScheduleService
 from tenzing_tpu.serve.store import ScheduleStore, WorkQueue, merge_records
 
 __all__ = [
+    "DaemonOpts",
+    "DrainDaemon",
     "Resolution",
     "Resolver",
     "ScheduleService",

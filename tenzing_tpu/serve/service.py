@@ -202,11 +202,9 @@ class ScheduleService:
                "model": (self.model_path
                          if os.path.exists(self.model_path) else None)}
         if self.queue is not None:
-            items = self.queue.items()
-            out["queue"] = {
-                "dir": self.queue.dir,
-                "depth": len(items),
-                "reasons": sorted({i[1].get("reason", "?")
-                                   for i in items}),
-            }
+            # full queue stats (serve/store.py WorkQueue.stats): depth by
+            # reason plus the drain-daemon protocol state — the torn set
+            # (visible rot, never silently dropped), live leases with
+            # heartbeat ages, and the poison quarantine
+            out["queue"] = self.queue.stats()
         return out

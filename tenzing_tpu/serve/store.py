@@ -45,6 +45,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tenzing_tpu.fault.checkpoint import atomic_write_json, read_checked_json
@@ -395,7 +396,25 @@ class WorkQueue:
     suggested ``checkpoint`` directory makes the search itself
     kill-resumable.  Item filenames key on the exact fingerprint digest,
     so re-querying a cold fingerprint re-asserts one item instead of
-    piling duplicates."""
+    piling duplicates.
+
+    The queue directory is also where the drain daemon
+    (serve/daemon.py, docs/serving.md "Drain daemon") keeps its
+    per-item protocol state, all keyed by the same exact digest:
+
+    * ``lease-<exact>.json``  — a live claim (owner payload; the file's
+      mtime is the heartbeat — a stale mtime is an expired lease);
+    * ``fail-<exact>.json``   — the persistent failure history a poison
+      verdict accumulates across daemon restarts;
+    * ``poison-<exact>.json`` — a poisoned item (checkpoint envelope,
+      ``kind: "poisoned_request"``) quarantined out of the drain loop;
+    * ``ckpt-<exact>/``       — the item's ``SearchCheckpoint``
+      directory (suggested at enqueue time, used by the drain);
+    * ``status-<owner>.json`` — each daemon's liveness/status document.
+
+    Only ``work-*.json`` files are queue *items*; every listing method
+    here ignores the rest, and vice versa.
+    """
 
     def __init__(self, directory: str):
         # the directory is created on first enqueue, NOT here: read-only
@@ -404,9 +423,36 @@ class WorkQueue:
         # path and then report an empty queue where the real one lives
         # elsewhere
         self.dir = directory
+        # torn/corrupt item files seen by the LAST items() scan — the
+        # visible-rot satellite: a drainer must skip a torn item, but
+        # skipping silently hides queue damage from every dashboard
+        self.torn_paths: List[str] = []
+        # (name, mtime) pairs already counted, so a polling daemon does
+        # not inflate serve.queue.torn once per scan of the same damage
+        # (a rewrite of the file — new mtime — counts again)
+        self._torn_seen: set = set()
 
     def path_for(self, exact: str) -> str:
         return os.path.join(self.dir, f"work-{exact}.json")
+
+    def lease_path_for(self, exact: str) -> str:
+        return os.path.join(self.dir, f"lease-{exact}.json")
+
+    def fail_path_for(self, exact: str) -> str:
+        return os.path.join(self.dir, f"fail-{exact}.json")
+
+    def poison_path_for(self, exact: str) -> str:
+        return os.path.join(self.dir, f"poison-{exact}.json")
+
+    def checkpoint_dir_for(self, exact: str) -> str:
+        return os.path.join(self.dir, f"ckpt-{exact}")
+
+    @staticmethod
+    def exact_of(path: str) -> str:
+        """The exact fingerprint digest a queue file is keyed by."""
+        name = os.path.basename(path)
+        stem = name[:-len(".json")] if name.endswith(".json") else name
+        return stem.split("-", 1)[1] if "-" in stem else stem
 
     def ensure(self, fingerprint, request: Dict[str, Any],
                reason: str) -> str:
@@ -433,8 +479,7 @@ class WorkQueue:
             "reason": reason,
             "fingerprint": fingerprint.to_json(),
             "request": request,
-            "checkpoint": os.path.join(
-                self.dir, f"ckpt-{fingerprint.exact_digest}"),
+            "checkpoint": self.checkpoint_dir_for(fingerprint.exact_digest),
         })
         get_metrics().counter("serve.queue.enqueued").inc()
         tr = get_tracer()
@@ -446,9 +491,18 @@ class WorkQueue:
     def items(self) -> List[Tuple[str, Dict[str, Any]]]:
         """(path, payload) per valid queued item; invalid files are
         skipped (a drainer must never crash on one torn item), and a
-        not-yet-created queue directory is simply empty."""
+        not-yet-created queue directory is simply empty.  Torn/corrupt
+        item files are *counted* (``serve.queue.torn`` + a
+        ``serve.queue.torn_item`` tracer event, deduped per damaged
+        version) and kept in :attr:`torn_paths` so queue rot is visible
+        in ``serve stats`` and the report CLI instead of silently
+        shrinking the depth."""
         out = []
+        torn: List[str] = []
+        torn_keys: set = set()
         if not os.path.isdir(self.dir):
+            self._torn_seen = torn_keys
+            self.torn_paths = torn
             return out
         for name in sorted(os.listdir(self.dir)):
             if not (name.startswith("work-") and name.endswith(".json")):
@@ -456,9 +510,94 @@ class WorkQueue:
             path = os.path.join(self.dir, name)
             try:
                 out.append((path, read_checked_json(path)))
-            except Exception:
+            except Exception as e:
+                torn.append(path)
+                try:
+                    key = (name, os.path.getmtime(path))
+                except OSError:
+                    key = (name, None)
+                torn_keys.add(key)
+                if key not in self._torn_seen:
+                    self._torn_seen.add(key)
+                    get_metrics().counter("serve.queue.torn").inc()
+                    tr = get_tracer()
+                    if tr.enabled:
+                        tr.event("serve.queue.torn_item", file=name,
+                                 error=type(e).__name__,
+                                 message=str(e)[:200])
                 continue
+        # the dedup set tracks only the *currently* torn versions — a
+        # long-lived poller facing an ever-rewriting broken producer must
+        # not accumulate one key per damaged version forever
+        self._torn_seen &= torn_keys
+        self.torn_paths = torn
         return out
+
+    def leases(self) -> List[Dict[str, Any]]:
+        """Live-claim documents, one per ``lease-*.json``: the owner
+        payload (tolerating a torn lease — only the mtime is
+        load-bearing for expiry) plus ``age_s`` since the last heartbeat
+        renewal."""
+        out: List[Dict[str, Any]] = []
+        if not os.path.isdir(self.dir):
+            return out
+        now = time.time()
+        for name in sorted(os.listdir(self.dir)):
+            if not (name.startswith("lease-") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.dir, name)
+            doc: Dict[str, Any] = {"path": path,
+                                   "exact": self.exact_of(path)}
+            try:
+                doc["age_s"] = round(now - os.path.getmtime(path), 3)
+            except OSError:
+                continue  # released between listdir and stat
+            try:
+                with open(path) as f:
+                    doc.update(json.load(f))
+            except (OSError, ValueError):
+                pass  # claim raced mid-publish; mtime alone still counts
+            out.append(doc)
+        return out
+
+    def poisoned(self) -> List[Tuple[str, Dict[str, Any]]]:
+        """(path, payload) per poison-quarantined item (the drain
+        daemon's deterministic-failure verdicts, docs/serving.md);
+        unreadable poison files are returned with an ``unreadable``
+        payload rather than hidden — poison is exactly the rot a
+        dashboard must see."""
+        out: List[Tuple[str, Dict[str, Any]]] = []
+        if not os.path.isdir(self.dir):
+            return out
+        for name in sorted(os.listdir(self.dir)):
+            if not (name.startswith("poison-") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                out.append((path, read_checked_json(path)))
+            except Exception as e:
+                out.append((path, {"unreadable": f"{type(e).__name__}: "
+                                                 f"{str(e)[:200]}"}))
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        """Queue occupancy for ``serve stats`` and the report CLI:
+        depth + reasons, the torn set (visible rot), live leases, and
+        poison quarantine size."""
+        items = self.items()
+        by_reason: Dict[str, int] = {}
+        for _, payload in items:
+            r = payload.get("reason", "?")
+            by_reason[r] = by_reason.get(r, 0) + 1
+        return {
+            "dir": self.dir,
+            "depth": len(items),
+            "reasons": sorted(by_reason),
+            "by_reason": dict(sorted(by_reason.items())),
+            "torn": [os.path.basename(p) for p in self.torn_paths],
+            "leases": self.leases(),
+            "poisoned": [os.path.basename(p) for p, _ in self.poisoned()],
+        }
 
     def __len__(self) -> int:
         return len(self.items())

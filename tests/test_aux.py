@@ -124,6 +124,7 @@ def test_example_spmv_mcts_smoke():
     assert p.stdout.strip()
 
 
+@pytest.mark.needs_pinned_host
 def test_example_moe_mcts_smoke():
     p = subprocess.run(
         [sys.executable, "examples/moe_mcts.py", "--cpu", "--tokens", "32",

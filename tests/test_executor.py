@@ -90,6 +90,7 @@ def test_every_searched_schedule_computes_the_same_answer():
         np.testing.assert_allclose(np.asarray(out["z"]), np.asarray(want), rtol=1e-5)
 
 
+@pytest.mark.needs_tie_hlo
 def test_token_ties_survive_compilation():
     """The ordering tokens are data dependencies (select-based ties) precisely
     because the TPU backend strips ``opt-barrier`` post-optimization (measured
@@ -104,6 +105,7 @@ def test_token_ties_survive_compilation():
     assert "select(" in txt or "select.s" in txt or " select" in txt
 
 
+@pytest.mark.needs_tie_hlo
 def test_different_schedules_compile_to_different_programs():
     """A fully-serialized 1-lane order and a 2-lane order of the same DAG must
     not lower to the same executable — otherwise the search space is
@@ -172,6 +174,7 @@ class Shift(DeviceOp):
         return {"v": jax.lax.ppermute(bufs["v"], "d", perm)}
 
 
+@pytest.mark.needs_shard_map
 def test_mesh_sharded_schedule_with_collective():
     from jax.sharding import Mesh, PartitionSpec as P
 

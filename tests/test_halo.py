@@ -49,6 +49,7 @@ def test_graph_shape():
         assert [s.name() for s in g.succs(post)] == [f"await_{n}"]
 
 
+@pytest.mark.needs_shard_map
 def test_halo_exchange_correct_2x2x2():
     g, plat, ex, want = make_setup()
     st = get_all_sequences(g, plat, max_seqs=1)[0]
@@ -56,6 +57,7 @@ def test_halo_exchange_correct_2x2x2():
     np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
 
 
+@pytest.mark.needs_shard_map
 def test_halo_exchange_schedules_agree():
     g, plat, ex, want = make_setup()
     states = get_all_sequences(g, plat, max_seqs=3)
@@ -64,6 +66,7 @@ def test_halo_exchange_schedules_agree():
         np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
 
 
+@pytest.mark.needs_shard_map
 def test_halo_1d_mesh():
     # degenerate 4x1x1 mesh: only x faces move data across shards
     from jax.sharding import Mesh

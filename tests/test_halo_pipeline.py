@@ -37,12 +37,14 @@ def _executor(args=ARGS, n_lanes=2):
     return TraceExecutor(Platform.make_n_lanes(n_lanes), jbufs), want
 
 
+@pytest.mark.needs_pinned_host
 def test_naive_order_numerics():
     ex, want = _executor(n_lanes=1)
     out = ex.run(naive_order(ARGS, ex.platform))
     np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
 
 
+@pytest.mark.needs_pinned_host
 def test_searched_schedules_same_answer():
     """Any legal order x lane assignment computes the periodic ghost fill."""
     ex, want = _executor()
@@ -248,6 +250,7 @@ def test_batched_variant_on_menu_only_when_it_differs():
     assert len(UnpackChoice(args, (0, 0, 1)).choices()) == 3
 
 
+@pytest.mark.needs_pinned_host
 def test_impl_choice_graph_enumerates_kernel_menu():
     """With impl_choice=True the solver sees ChooseOp decisions for pack/unpack
     and every resolved schedule still computes the right answer."""
@@ -265,6 +268,7 @@ def test_impl_choice_graph_enumerates_kernel_menu():
         np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
 
 
+@pytest.mark.needs_pinned_host
 def test_single_device_numerics_subprocess():
     """Regression: on a SINGLE device (no xla_force_host_platform_device_count,
     the configuration the real TPU bench runs in), spilling 4D faces with tiny
@@ -305,6 +309,7 @@ print("SINGLE_DEVICE_OK")
     assert "SINGLE_DEVICE_OK" in out.stdout, out.stdout + out.stderr
 
 
+@pytest.mark.needs_pinned_host
 def test_pipeline_benchmarkable_smoke():
     from tenzing_tpu.bench.benchmarker import BenchOpts, EmpiricalBenchmarker
 
@@ -316,6 +321,7 @@ def test_pipeline_benchmarkable_smoke():
     assert res.pct50 > 0.0
 
 
+@pytest.mark.needs_pinned_host
 def test_greedy_overlap_order_legal_disciplined_and_correct():
     """The greedy incumbent (bench.py's anytime seed): every prefix passes the
     sync oracle, every transfer is posted before any await (the discipline the
@@ -342,6 +348,7 @@ def test_greedy_overlap_order_legal_disciplined_and_correct():
     np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
 
 
+@pytest.mark.needs_pinned_host
 def test_index_tie_survives_compilation():
     """The INDEX_TIE pack's token edge must survive XLA compilation as a
     DYNAMIC slice start (the select-derived zero on the direction axis).

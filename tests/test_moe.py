@@ -53,6 +53,7 @@ class TestDagShape:
         assert len(seqs) > 1
 
 
+@pytest.mark.needs_shard_map
 class TestNumerics:
     @pytest.mark.parametrize("nep", [2, 4])
     def test_matches_dense_routing(self, nep):

@@ -43,6 +43,7 @@ class TestDagShape:
         assert len(seqs) > 1
 
 
+@pytest.mark.needs_pinned_host
 class TestNumerics:
     def test_naive_matches_dense_routing(self):
         bufs, want, cap = make_pipe_buffers(SMALL, seed=1)
@@ -89,6 +90,7 @@ class TestNumerics:
                                    atol=2e-5)
 
 
+@pytest.mark.needs_pinned_host
 class TestStagingPrecision:
     def test_bf16_chain_matches_within_bf16_tolerance(self):
         bufs, want, cap = make_pipe_buffers(SMALL, seed=6, staging="bf16")

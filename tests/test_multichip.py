@@ -29,6 +29,7 @@ def make_setup(dp, sp, rows=32, batch=4):
     return g, plat, TraceExecutor(plat, bufs), want
 
 
+@pytest.mark.needs_shard_map
 def test_dist_spmv_correct_on_2x4_mesh():
     g, plat, ex, want = make_setup(dp=2, sp=4)
     st = get_all_sequences(g, plat, max_seqs=1)[0]
@@ -36,6 +37,7 @@ def test_dist_spmv_correct_on_2x4_mesh():
     np.testing.assert_allclose(np.asarray(out["Y"]), want, rtol=2e-3)
 
 
+@pytest.mark.needs_shard_map
 def test_dist_spmv_all_schedules_agree_on_1x4_mesh():
     g, plat, ex, want = make_setup(dp=1, sp=4, rows=16, batch=2)
     states = get_all_sequences(g, plat, max_seqs=6)
@@ -45,6 +47,7 @@ def test_dist_spmv_all_schedules_agree_on_1x4_mesh():
         np.testing.assert_allclose(np.asarray(out["Y"]), want, rtol=2e-3)
 
 
+@pytest.mark.needs_shard_map
 def test_graft_dryrun_multichip():
     import __graft_entry__ as ge
 

@@ -10,6 +10,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DRIVER = """
@@ -184,6 +186,7 @@ print(f"RANK{pid}_OK {fp}", flush=True)
 """
 
 
+@pytest.mark.needs_multiprocess
 def test_two_process_dfs_explore():
     _run_two_ranks(DRIVER)
 
@@ -206,6 +209,7 @@ def test_two_process_injection_agreement():
         raise
 
 
+@pytest.mark.needs_multiprocess
 def test_two_process_mcts_explore():
     """The MCTS per-iteration protocol — rank-0 rollout, stop + schedule
     broadcast, all-rank benchmark, rank-0 backprop (reference

@@ -56,6 +56,7 @@ class TestDagShape:
         assert len(seqs) > 1
 
 
+@pytest.mark.needs_shard_map
 class TestNumerics:
     @pytest.mark.parametrize("npp,m,v", [(2, 4, 2), (4, 4, 2), (4, 4, 1)])
     def test_matches_stage_stack(self, npp, m, v):
@@ -93,6 +94,7 @@ class TestTrainStep:
         return g
 
     @pytest.mark.parametrize("npp,m,v", [(2, 4, 2), (4, 4, 2), (4, 2, 1)])
+    @pytest.mark.needs_shard_map
     def test_dw_matches_host_backward(self, npp, m, v):
         from tenzing_tpu.models.pipeline import make_train_buffers
 
@@ -128,6 +130,7 @@ class TestTrainStep:
         last_f = by_name[f"fcompute_0_{args.chain_ticks - 1}"]
         assert by_name["binject_0_0"] in g.succs(last_f)
 
+    @pytest.mark.needs_shard_map
     def test_every_schedule_computes_same_dw(self):
         from tenzing_tpu.models.pipeline import make_train_buffers
 

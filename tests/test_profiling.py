@@ -9,6 +9,8 @@ tests/test_attrib.py."""
 
 import numpy as np
 
+import pytest
+
 from tenzing_tpu.obs.attrib.xplane import (
     analyze_trace,
     capture_trace,
@@ -23,6 +25,7 @@ def test_merge_intervals_coalesces_and_counts_once():
     assert sum(b - a for a, b in merged) == 45
 
 
+@pytest.mark.needs_profile_data
 def test_capture_trace_produces_parseable_xplane(tmp_path):
     import jax.numpy as jnp
 

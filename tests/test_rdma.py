@@ -18,6 +18,7 @@ from tenzing_tpu.ops.rdma import RdmaCopyStart, rdma_shift_fused
 from tenzing_tpu.runtime.executor import TraceExecutor
 
 
+@pytest.mark.needs_shard_map
 def test_shift_fused_matches_roll_1d():
     devs = np.array(jax.devices()[:8])
     mesh = Mesh(devs, ("x",))
@@ -34,6 +35,7 @@ def test_shift_fused_matches_roll_1d():
 
 
 @pytest.mark.parametrize("axis,dim", [("x", 0), ("y", 1), ("z", 2)])
+@pytest.mark.needs_shard_map
 def test_shift_fused_matches_roll_3d_mesh(axis, dim):
     devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
     mesh = Mesh(devs, ("x", "y", "z"))
@@ -52,6 +54,7 @@ def test_shift_fused_matches_roll_3d_mesh(axis, dim):
     )
 
 
+@pytest.mark.needs_shard_map
 def test_shift_axis_size_one_is_loopback_copy():
     """n=1 degenerates to the self copy (no barrier, the single-chip case)."""
     devs = np.array(jax.devices()[:1])
@@ -100,6 +103,7 @@ def _pipeline_fixture():
 
 
 @pytest.mark.parametrize("engine", [".host", ".rdma"])
+@pytest.mark.needs_pinned_host
 def test_pipeline_transfer_menu_both_engines_correct(engine):
     """The halo pipeline's transfer-engine ChoiceOp: both the host round trip
     and the device-resident RDMA copy must produce the exchanged grid."""
@@ -121,6 +125,7 @@ def test_pipeline_transfer_menu_both_engines_correct(engine):
     )
 
 
+@pytest.mark.needs_pinned_host
 def test_pipeline_rdma_benchmark_loop_runs():
     """The split/fused RDMA path must survive the benchmark hot loop's
     fori_loop carry (prepare_n): the inflight closure settles within one
@@ -132,6 +137,7 @@ def test_pipeline_rdma_benchmark_loop_runs():
     run_n(2)  # raises on any carry-structure mismatch
 
 
+@pytest.mark.needs_shard_map
 def test_halo_mesh_exchange_menu_both_engines_correct():
     """The mesh halo's exchange ChoiceOp (XLA collective-permute vs Pallas
     remote DMA) — both engines fill every ghost face with the periodic
@@ -153,6 +159,7 @@ def test_halo_mesh_exchange_menu_both_engines_correct():
         np.testing.assert_allclose(np.asarray(out["U"]), want)
 
 
+@pytest.mark.needs_pinned_host
 def test_rdma_copy_start_serdes_roundtrip():
     """Graph-anchored serdes finds the RDMA op inside the ChoiceOp menu."""
     from tenzing_tpu.core.serdes import sequence_from_json, sequence_to_json
@@ -166,6 +173,7 @@ def test_rdma_copy_start_serdes_roundtrip():
     ]
 
 
+@pytest.mark.needs_pinned_host
 def test_moe_pipeline_rdma_engine_correct():
     """The MoE chunk chains' rdma staging variant produces the routed MoE
     output (engine dimension of the staging menu, models/moe_pipeline.py)."""

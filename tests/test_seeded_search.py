@@ -41,6 +41,7 @@ class CountingBench:
         return BenchResult.from_times([1.0 + 0.001 * self.calls] * 3)
 
 
+@pytest.mark.needs_pinned_host
 def test_paired_order_numerics():
     """The paired await/unpack incumbent is a legal schedule with correct
     results, for both the phase and the mixed-engine realizations."""

@@ -356,3 +356,74 @@ def test_workqueue_checkpoint_format_and_idempotence(tmp_path, spmv):
     with open(os.path.join(q.dir, "work-torn.json"), "w") as f:
         f.write("{")
     assert len(q.items()) == 1
+    # ...and is VISIBLE, not silently dropped (ISSUE 9 satellite): the
+    # scan records the torn set for serve stats / the report CLI
+    assert [os.path.basename(p) for p in q.torn_paths] == ["work-torn.json"]
+    st = q.stats()
+    assert st["depth"] == 1 and st["torn"] == ["work-torn.json"]
+
+
+def test_workqueue_concurrent_writers_one_valid_item(tmp_path, spmv):
+    """Two writers asserting the same fingerprint concurrently (the
+    fleet-rate near-miss path): exactly one item file survives, and it
+    is a VALID digest-checked envelope — atomic_write_json's
+    tmp+fsync+rename means last-wins, never torn."""
+    import threading
+
+    from tenzing_tpu.fault.checkpoint import read_checked_json
+
+    _, fps, _ = spmv
+    q = WorkQueue(str(tmp_path / "queue"))
+    req = DriverRequest(workload="spmv", m=512)
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def writer(tenant):
+        try:
+            barrier.wait()
+            for i in range(25):
+                q.ensure(fps["a"], req.to_json(), reason=f"cold-{tenant}")
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    ts = [threading.Thread(target=writer, args=(t,)) for t in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    names = [n for n in os.listdir(q.dir) if n.startswith("work-")]
+    assert names == [f"work-{fps['a'].exact_digest}.json"]
+    payload = read_checked_json(q.path_for(fps["a"].exact_digest))
+    assert payload["kind"] == "search_request"
+    assert payload["reason"].startswith("cold-")
+    assert DriverRequest(**payload["request"]).m == 512
+
+
+def test_workqueue_torn_item_reassert_under_concurrent_ensure(tmp_path, spmv):
+    """The ensure() torn-item re-assert path raced by a second ensure:
+    whatever interleaving wins, the surviving file is a valid envelope
+    for the fingerprint."""
+    import threading
+
+    from tenzing_tpu.fault.checkpoint import read_checked_json
+
+    _, fps, _ = spmv
+    q = WorkQueue(str(tmp_path / "queue"))
+    req = DriverRequest(workload="spmv", m=512)
+    path = q.ensure(fps["a"], req.to_json(), reason="cold")
+    with open(path, "w") as f:
+        f.write("{not json")  # torn by a crashed writer
+    barrier = threading.Barrier(2)
+
+    def reassert():
+        barrier.wait()
+        q.ensure(fps["a"], req.to_json(), reason="cold")
+
+    ts = [threading.Thread(target=reassert) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    payload = read_checked_json(path)  # valid again, digest-checked
+    assert payload["fingerprint"]["exact"] == fps["a"].exact_digest

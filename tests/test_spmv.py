@@ -202,6 +202,7 @@ def test_read_matrix_market_truncated_raises(tmp_path):
         read_matrix_market(str(t2))
 
 
+@pytest.mark.needs_pinned_host
 def test_spmv_host_exchange_schedules_correct():
     """exchange="host": the x exchange is a posted async host round-trip with
     the post/wait split (the reference's PostSend/WaitRecv analog,

@@ -61,6 +61,7 @@ def test_negotiation_covers_every_remote_column():
                 assert plan.owner(c) == (p - d) % n_sp
 
 
+@pytest.mark.needs_shard_map
 def test_random_matrix_numerics_all_distances():
     """A uniform random matrix needs every cyclic distance — the case the band
     model cannot express."""
@@ -71,6 +72,7 @@ def test_random_matrix_numerics_all_distances():
     np.testing.assert_allclose(outs[0], want, rtol=2e-3)
 
 
+@pytest.mark.needs_shard_map
 def test_numerics_stable_across_schedules():
     a = random_matrix(32, 32, 200, seed=7)
     outs, want, _ = _run(a, n_sp=4, dp=1, batch=2, max_schedules=6)
@@ -79,6 +81,7 @@ def test_numerics_stable_across_schedules():
         np.testing.assert_allclose(y, want, rtol=2e-3)
 
 
+@pytest.mark.needs_shard_map
 def test_band_matrix_degenerates_to_adjacent_steps():
     """Half-bandwidth < block: the irregular machinery retains exactly the two
     adjacent cyclic distances (the spmv_dist.py static-neighbor case)."""
@@ -89,6 +92,7 @@ def test_band_matrix_degenerates_to_adjacent_steps():
     np.testing.assert_allclose(outs[0], want, rtol=2e-3)
 
 
+@pytest.mark.needs_shard_map
 def test_block_diagonal_needs_no_exchange():
     a = random_band_matrix(64, 0, 200, seed=4)  # diagonal only
     plan = negotiate_exchange(a, 4)
@@ -97,6 +101,7 @@ def test_block_diagonal_needs_no_exchange():
     np.testing.assert_allclose(outs[0], want, rtol=2e-3)
 
 
+@pytest.mark.needs_shard_map
 def test_exchange_impl_choice_all_variants_correct():
     """With impl_choice the exchange realization is a ChoiceOp: per-distance
     permutes vs one padded all-to-all (the Ialltoallv analog,

@@ -50,6 +50,7 @@ class TestDagShape:
         assert len(seqs) > 1
 
 
+@pytest.mark.needs_shard_map
 class TestNumerics:
     @pytest.mark.parametrize("ntp,layers,chunks", [(2, 2, 2), (4, 3, 2), (4, 1, 1)])
     def test_matches_unsharded_stack(self, ntp, layers, chunks):
