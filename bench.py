@@ -166,6 +166,26 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "outputs against the stepped program (allclose + "
                          "re-verified), and stamp the ``perf.fused`` block "
                          "(regions, tiles, dispatch overhead before/after)")
+    ap.add_argument("--fuse-search-tiles", action="store_true",
+                    help="run the megakernel tile-count decision nodes in "
+                         "the driver's search path (docs/performance.md): "
+                         "a FuseTileChoice planted in the choice graph is "
+                         "searched by MCTS/DFS/hill-climb like any kernel "
+                         "menu, every measurement lowers through the "
+                         "schedule's fuse_tile.tN directive, and the "
+                         "``perf.fuse_search_tiles`` block records the "
+                         "menu and the chosen count")
+    ap.add_argument("--chunk", action="store_true",
+                    help="T3-style op chunking (docs/performance.md, "
+                         "'Chunked overlap'): expand the workload's "
+                         "expensive ops into searchable n-way chunked "
+                         "variants (core/chunking.py) so a transfer "
+                         "overlaps its own producer/consumer; chunk "
+                         "counts are roofline-pruned menu entries the "
+                         "solvers search like any kernel choice, and the "
+                         "driver stamps the ``perf.chunked`` provenance "
+                         "block (menus, searched/chosen counts, hidden "
+                         "comm estimated vs measured)")
     ap.add_argument("--no-verify", action="store_true",
                     help="disable the independent schedule-soundness "
                          "verifier (docs/robustness.md): the guard in the "

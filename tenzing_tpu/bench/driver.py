@@ -131,6 +131,8 @@ class DriverRequest:
     profile_winner: bool = False
     profile_repeats: int = 7
     fuse_winner: bool = False
+    fuse_search_tiles: bool = False
+    chunk: bool = False
     no_verify: bool = False
     verify_tol: float = 0.02
 
@@ -311,7 +313,8 @@ def build_moe(args):
     jbufs = TraceExecutor.place_host_buffers(
         bufs, host_buffer_names(margs, staging=staging))
     impl_choice = not args.smoke  # same rationale as build_halo
-    g = build_graph(margs, cap, impl_choice=impl_choice, staging=staging)
+    g = build_graph(margs, cap, impl_choice=impl_choice, staging=staging,
+                    chunk=args.chunk, chunk_relax=args.smoke)
     return g, jbufs, metric_for("moe", args), (margs, cap)
 
 
@@ -333,7 +336,8 @@ def build_attn(args):
     bufs, _ = make_blocked_buffers(aargs, seed=0)
     bufs = {k: jnp.asarray(v) for k, v in bufs.items()}
     g = Graph()
-    op = BlockedAttention(aargs, impl_choice=True, fused_choice=True)
+    op = BlockedAttention(aargs, impl_choice=True, fused_choice=True,
+                          chunk=args.chunk, chunk_relax=args.smoke)
     g.start_then(op)
     g.then_finish(op)
     return g, bufs, metric_for("attn", args), aargs
@@ -461,7 +465,8 @@ def graph_for(req: DriverRequest):
         staging = "f32" if req.smoke else "choice"
         bufs, _, cap = make_pipe_buffers(margs, seed=0, with_expected=False,
                                          staging=staging)
-        g = build_graph(margs, cap, impl_choice=impl_choice, staging=staging)
+        g = build_graph(margs, cap, impl_choice=impl_choice, staging=staging,
+                        chunk=req.chunk, chunk_relax=req.smoke)
         return g, {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
     if w == "attn":
         from tenzing_tpu.core.graph import Graph
@@ -474,7 +479,8 @@ def graph_for(req: DriverRequest):
         aargs = RingAttnArgs(**workload_shape(req))
         bufs, _ = make_blocked_buffers(aargs, seed=0)
         g = Graph()
-        op = BlockedAttention(aargs, impl_choice=True, fused_choice=True)
+        op = BlockedAttention(aargs, impl_choice=True, fused_choice=True,
+                              chunk=req.chunk, chunk_relax=req.smoke)
         g.start_then(op)
         g.then_finish(op)
         return g, {k: int(getattr(v, "nbytes", 0)) for k, v in bufs.items()}
@@ -747,7 +753,65 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
     if args.smoke:
         args.mcts_iters = min(args.mcts_iters, 12)
     ex = TraceExecutor(plat, bufs)
-    emp = EmpiricalBenchmarker(ex)
+    # --fuse-search-tiles (ISSUE 10 satellite of the PR-8 backend): plant
+    # the megakernel tile menu as a decision node in the choice graph BEFORE
+    # the verifier/search are built, so MCTS/DFS/hill-climb search tile
+    # counts in-driver (the way tests/test_fused.py drives the library
+    # workloads) instead of only sweeping the menu post-verdict.  Every
+    # measurement then lowers through the schedule's ``fuse_tile.tN``
+    # directive (FusedExecutor reads it back; tiles=None).
+    measure_ex = ex
+    tile_menu = None
+    tile_planted = False
+    if args.fuse_search_tiles:
+        from tenzing_tpu.runtime.fused import FusedExecutor, with_tile_menu
+
+        # the menu needs a complete schedule to partition: the cheap
+        # first-decision serialization on one lane (host-side only)
+        probe_state = State(g)
+        probe_plat = Platform.make_n_lanes(1)
+        while not probe_state.is_terminal():
+            probe_state = probe_state.apply(
+                probe_state.get_decisions(probe_plat)[0])
+        # smoke relaxes the traffic floor like tests/test_fused.py
+        # (min_tile_bytes=0): toy buffers would prune every count and CI
+        # could never exercise the searched tile nodes
+        fuse_kw = {"min_tile_bytes": 0} if args.smoke else {}
+        tile_menu = FusedExecutor(ex, **fuse_kw).plan(
+            probe_state.sequence).tile_menu
+        if len(tile_menu) > 1:
+            g = with_tile_menu(g, tile_menu)
+            measure_ex = FusedExecutor(ex, **fuse_kw)
+            tile_planted = True
+            sys.stderr.write(
+                f"fuse-search-tiles: menu {tile_menu} planted in the "
+                "choice graph; measurements lower through the searched "
+                "directive\n")
+        else:
+            sys.stderr.write(
+                "fuse-search-tiles: tile menu is [1] (no fusible "
+                "decomposition survived pruning) — nothing to search\n")
+
+    def with_tile1(seq):
+        """An out-of-graph sequence (naive_order/greedy helpers, recorded
+        rows predating the tile node) completed with the ``fuse_tile.t1``
+        directive the planted choice requires — without it the verifier
+        would reject the schedule as an unresolved choice.  The directive
+        goes AFTER the leading start sentinel: the planted choice is a
+        successor of Start, so a directive at position 0 would violate
+        the projected start->directive edge and fail verification."""
+        if not tile_planted:
+            return seq
+        from tenzing_tpu.core.sequence import Sequence as _Seq
+        from tenzing_tpu.runtime.fused import FuseTile, TILE_PREFIX
+
+        ops_ = list(seq.vector())
+        if any(op.name().startswith(TILE_PREFIX) for op in ops_):
+            return seq
+        at = 1 if ops_ and ops_[0].name() == "start" else 0
+        return _Seq(ops_[:at] + [FuseTile(1)] + ops_[at:])
+
+    emp = EmpiricalBenchmarker(measure_ex)
     # fault-tolerance stack (docs/robustness.md), inside-out:
     #   EmpiricalBenchmarker            device measurement
     #   [FaultInjectingBenchmarker]     --inject-faults seeded chaos
@@ -815,8 +879,8 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
         # only) and BELOW the resilient layer (surfaced compile failures
         # ride the normal classify/agree/quarantine path)
         measured_stack = prefetcher = PrefetchingBenchmarker(
-            measured_stack, executor=ex, workers=args.prefetch_compiles,
-            rank=surrogate)
+            measured_stack, executor=measure_ex,
+            workers=args.prefetch_compiles, rank=surrogate)
         # exception paths too (not only the happy-path close below): a
         # fatal mid-search error must not leave queued background compiles
         # draining at interpreter exit — the pool's own shutdown hook joins
@@ -919,6 +983,9 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
         while not naive_state.is_terminal():
             naive_state = naive_state.apply(naive_state.get_decisions(naive_plat)[0])
         naive_seq = naive_state.sequence
+    # a planted tile menu makes the directive part of every complete
+    # schedule; the out-of-graph naive builders predate it
+    naive_seq = with_tile1(naive_seq)
     # the baseline is not a search candidate: exempt it from the
     # identity-keyed candidate-fault kinds (deterministic/corrupt), which
     # would otherwise deterministically kill the run under ~rate of the
@@ -1108,6 +1175,7 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
                     "greedy-f32-rdma",
                     greedy_overlap_order(margs_, cap_, plat, engine="rdma"),
                 ))
+        greedy_seqs = [(label, with_tile1(s)) for label, s in greedy_seqs]
         if prefetcher is not None:
             # the incumbent grid is known up front: incumbent k+1 compiles
             # in the background while incumbent k measures
@@ -1156,6 +1224,8 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
             paths, g, args.seed_topk,
             log=lambda m: sys.stderr.write(m + "\n"),
         )
+        # recorded rows predating a planted tile menu carry no directive
+        picked = [(with_tile1(s), r) for s, r in picked]
         recorded_ok = []
         if prefetcher is not None:
             prefetcher.prefetch([s for s, _ in picked])
@@ -1873,6 +1943,91 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
                 f"{str(e)[:200]})\n")
             fused_block = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
 
+    # op-chunking provenance (ISSUE 10, docs/performance.md "Chunked
+    # overlap"): the roofline-pruned chunk menus the models offered, what
+    # the search visited and chose, and the hidden comm the chunking bought
+    # — estimated (the roofline upper bound carried on the menu) vs
+    # measured (transfer-unit overlap with the chunk partials on the
+    # obs/attrib stepped timeline).  Like profiling/fusion, provenance
+    # only: a failure degrades to an error-carrying block.
+    chunked_block = None
+    if args.chunk:
+        try:
+            from tenzing_tpu.core.chunking import chunk_menus, chunks_of
+
+            menus = chunk_menus(g)
+            chosen = chunks_of(reported_seq)
+            searched_counts: set = set()
+            n_cand_chunked = 0
+            for s in res.sims:
+                cm = chunks_of(s.order)
+                if cm:
+                    n_cand_chunked += 1
+                    searched_counts.update(cm.values())
+            est_total = 0.0
+            for base, n in chosen.items():
+                m = menus.get(base)
+                if m:
+                    est_total += float(
+                        m.get("est_hidden_us", {}).get(n, 0.0))
+            chunked_block = {
+                "menus": {
+                    b: {"counts": list(m["counts"]),
+                        "est_hidden_us": {
+                            str(k): round(float(v), 2)
+                            for k, v in m.get("est_hidden_us", {}).items()}}
+                    for b, m in sorted(menus.items())},
+                "searched_counts": sorted(int(c) for c in searched_counts),
+                "n_candidates_chunked": n_cand_chunked,
+                "chosen": {b: int(n) for b, n in sorted(chosen.items())},
+                "hidden_comm_us": {"estimated": round(est_total, 2),
+                                   "measured": None},
+            }
+            if menus and all(
+                    not [c for c in m["counts"] if c > 1]
+                    for m in menus.values()):
+                chunked_block["note"] = (
+                    "roofline pruned every chunking: no transfer whose "
+                    "hidden-comm bound beats the dispatch+combine cost on "
+                    "this workload/hardware (bench/roofline.py::"
+                    "prune_chunkings)")
+            elif not menus:
+                chunked_block["note"] = (
+                    "workload offers no chunkable-op menus (--chunk is a "
+                    "no-op for it)")
+            if chosen and not resilient.degraded:
+                from tenzing_tpu.core.chunking import hidden_comm_measured_us
+                from tenzing_tpu.obs import attrib as _attrib
+
+                t0 = time.time()
+                if profiled_attrib is not None:
+                    at_c = profiled_attrib
+                else:
+                    tl_c = _attrib.stepped_timeline(
+                        ex, reported_seq, repeats=args.profile_repeats)
+                    at_c = _attrib.analyze(reported_seq.vector(), tl_c,
+                                           measured_us=value_us)
+                measured = hidden_comm_measured_us(reported_seq.vector(),
+                                                   at_c)
+                chunked_block["hidden_comm_us"]["measured"] = round(
+                    measured, 2)
+                sys.stderr.write(
+                    "chunked: winner uses %s; hidden comm est %.1fus / "
+                    "measured %.1fus (wall %.0fs)\n"
+                    % (chunked_block["chosen"], est_total, measured,
+                       time.time() - t0))
+            else:
+                sys.stderr.write(
+                    "chunked: %d menu(s), %d chunked candidate(s) "
+                    "searched, winner unchunked\n"
+                    % (len(menus), n_cand_chunked))
+        except Exception as e:
+            sys.stderr.write(
+                f"chunked provenance failed ({type(e).__name__}: "
+                f"{str(e)[:200]})\n")
+            chunked_block = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+
     if args.dump_csv:
         # One row per distinct schedule.  The decorrelated final-batch results
         # *supersede* the search-time measurements for naive and the finalists
@@ -1951,6 +2106,18 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
     # verdict, dispatch overhead before/after — present iff --fuse-winner
     if fused_block is not None:
         perf["fused"] = fused_block
+    # in-driver tile search provenance — present iff --fuse-search-tiles
+    if tile_menu is not None:
+        from tenzing_tpu.runtime.fused import tiles_of as _tiles_of
+
+        perf["fuse_search_tiles"] = {
+            "menu": list(tile_menu),
+            "planted": tile_planted,
+            "chosen": _tiles_of(reported_seq),
+        }
+    # op-chunking provenance (ISSUE 10) — present iff --chunk
+    if chunked_block is not None:
+        perf["chunked"] = chunked_block
     # regime metadata (VERDICT r4 item 6): cross-round vs_baseline
     # comparisons need the chip regime (naive_us), the measurement floors
     # that produced the verdict, and the warm-start provenance — without

@@ -34,6 +34,20 @@ V5E_VMEM_BYTES = 96 * 2**20
 # of traffic per grid step
 MIN_TILE_BYTES = 1 * 2**20
 
+# Per-dispatch overhead floor for chunk pruning: splitting an op into n
+# chunks adds n-1 separately dispatched programs, and the stepped-timeline
+# attribution numbers (obs/attrib, the MPK baseline measurement) put one
+# extra dispatch in the tens of microseconds on the v5e tunnel
+CHUNK_DISPATCH_US = 25.0
+# Staging-path bandwidth for hidden-comm bounds: the async host round-trip
+# DMA regime measured for the halo/MoE staged transfers (order of
+# magnitude; the bound is a can-it-help filter, not a performance model)
+V5E_XFER_GBS = 16.0
+# Menu cap on chunk counts: beyond 4 partials the added dispatches always
+# dominate on the shapes this repo measures, and every extra count grows
+# the solvers' decision space linearly
+MENU_CHUNK_CAP = 4
+
 
 @dataclass(frozen=True)
 class Cost:
@@ -142,6 +156,95 @@ def prune_tilings(cost: Cost, tile_counts, vmem_bytes: int = V5E_VMEM_BYTES,
             continue
         out.append(t)
     return out or [1]
+
+
+def op_roofline_us(cost: Cost) -> float:
+    """The analytic time floor of one op: the slower of its MXU and HBM
+    roofs (the same denominators :meth:`Cost.utilization` reads achieved
+    fractions against)."""
+    return max(cost.flops / V5E_PEAK_BF16_FLOPS,
+               cost.hbm_bytes / V5E_PEAK_HBM_BYTES) * 1e6
+
+
+def hidden_comm_bound_us(cost: Cost, chunks: int, comm_us: float) -> float:
+    """Upper bound on the comm time an ``n``-way chunking of an op costing
+    ``cost`` can newly hide: splitting exposes at most the op's tail —
+    a transfer can start after the first chunk instead of after the whole
+    op, so the newly overlappable window is ``(n-1)/n`` of the op's
+    analytic time — and hiding more comm than exists is impossible
+    (``comm_us``, the neighboring transfer's time)."""
+    if chunks <= 1:
+        return 0.0
+    return min(float(comm_us), op_roofline_us(cost) * (chunks - 1) / chunks)
+
+
+def prune_chunkings(cost: Cost, chunk_counts, comm_us=None,
+                    combine_bytes: float = 0.0,
+                    dispatch_us: float = CHUNK_DISPATCH_US,
+                    min_chunk_bytes: int = MIN_TILE_BYTES):
+    """Chunk counts of an audited op (core/chunking.py) that could
+    possibly help, from the structurally-valid candidates
+    ``chunk_counts`` — the TACCL-style sketch constraint keeping the
+    enlarged decision space tractable:
+
+    * ``n == 1`` (the unchunked op) always survives — it is the menu
+      entry the op itself provides;
+    * ``n > 1`` is dropped when the per-chunk share of the op's traffic
+      falls under ``min_chunk_bytes`` (the dispatch-overhead floor: a
+      chunk that small is all prologue, exactly the fused-tiling
+      ``MIN_TILE_BYTES`` argument); and
+    * when ``comm_us`` (the neighboring transfer's analytic time) is
+      given, ``n`` is dropped unless the hidden-comm upper bound
+      (:func:`hidden_comm_bound_us`) beats the added cost of chunking:
+      ``n-1`` extra dispatches plus ``n-1`` extra passes over the
+      combine traffic (``combine_bytes`` — the output bytes every
+      partial's read-modify-write re-presents, at HBM bandwidth).
+      ``comm_us=None`` skips this rule (the caller models no transfer —
+      only the traffic floor applies).
+
+    ``cost`` is the CHUNKED OP's own roofline cost (one op, not the whole
+    workload).  The menus the models build from this are what the
+    solvers search — measurements are never spent on chunkings the
+    analytic model already rules out.
+    """
+    out = []
+    for n in sorted({int(n) for n in chunk_counts}):
+        if n < 1:
+            continue
+        if n == 1:
+            out.append(1)
+            continue
+        if cost.hbm_bytes / n < min_chunk_bytes:
+            continue
+        if comm_us is not None:
+            added = (n - 1) * (float(dispatch_us) +
+                               float(combine_bytes) /
+                               V5E_PEAK_HBM_BYTES * 1e6)
+            if hidden_comm_bound_us(cost, n, comm_us) <= added:
+                continue
+        out.append(n)
+    return out or [1]
+
+
+def chunk_menu(counts, cost: Cost, comm_us=None, combine_bytes: float = 0.0,
+               relax: bool = False, cap: int = MENU_CHUNK_CAP):
+    """THE shared ``*_chunk_menu`` scaffold every audited model uses:
+    cap the op's structurally-valid chunk ``counts`` at ``cap`` partials,
+    ``relax=True`` (tests / CPU smoke / toy shapes) keeps them all
+    unpruned so the machinery stays searchable, otherwise
+    :func:`prune_chunkings` applies the sketch constraint against the
+    op's ``cost``/``comm_us``/``combine_bytes`` and each surviving
+    ``n > 1`` is priced by :func:`hidden_comm_bound_us`.  Returns the
+    ``(pruned counts, {count: est hidden µs})`` pair the models' choice
+    builders consume."""
+    counts = [int(c) for c in counts if int(c) <= cap]
+    if relax:
+        return list(counts), {}
+    pruned = prune_chunkings(cost, counts, comm_us=comm_us,
+                             combine_bytes=combine_bytes)
+    est = {n: hidden_comm_bound_us(cost, n, comm_us or 0.0)
+           for n in pruned if n > 1}
+    return pruned, est
 
 
 def spmv_cost(m: int, nnz: int, bytes_per_el: int = 4) -> Cost:

@@ -1,0 +1,299 @@
+"""Op chunking: fine-grained compute/comm overlap as searchable decisions.
+
+The searched schedules overlap *whole ops*: a transfer can hide behind a
+neighboring compute op, but never behind its own producer or consumer —
+once an expensive op starts, nothing else enters its lane until it
+finishes.  T3 (PAPERS.md) shows the big wins come from splitting exactly
+those ops into chunks so a collective overlaps the tail chunks of the op
+that feeds it.  TACCL (PAPERS.md) motivates the sketch-style constraint
+that keeps the enlarged space tractable: only chunkings the analytic
+roofline model says *can* help ever enter the menus
+(``bench/roofline.py::prune_chunkings``).
+
+This module is the mechanism, mirroring the megakernel-fusion protocol
+(PR 8, ``runtime/fused.py``) decision-for-decision:
+
+* **The protocol** — ``DeviceOp.chunkable()/chunk_counts()/split(n)``
+  (core/operation.py): an audited op expands into ``n`` partial ops whose
+  accumulating read-modify-write updates fold the combine into the chain
+  (the attention sub-folds chain through the online-softmax state; the
+  MoE/pipeline/TP partials chain through slice updates of the output
+  buffer).  :class:`ChunkedOp` packages one such expansion as an ordinary
+  CompoundOp — the scheduler inlines it via the existing ``ExpandOp``
+  machinery, so the partials become first-class schedule vertices other
+  ops (a pending transfer post, another chain's compute) interleave with.
+
+* **Searchable counts** — a chunked expansion is just another alternative
+  of an ordinary :class:`~tenzing_tpu.core.operation.ChoiceOp` (the
+  models append :class:`ChunkedOp` variants to their existing kernel
+  menus, or wrap a bare op in :class:`ChunkChoice`), resolved through the
+  ordinary ``ChooseOp`` decision.  MCTS, DFS and hill-climb therefore
+  search chunk counts with ZERO solver changes, the PR-4 verifier's
+  projected-graph model certifies chunked schedules as-is (the compound
+  expands, the choice resolves by executed names), and schedules/serdes/
+  corpus carry chunked schedules like any other.
+
+* **The executed directive** — every expansion plants a
+  :class:`ChunkDirective` (``<base>.chunk.c<N>``, kind-registered like
+  ``fuse_tile.tN``) as the compound's entry: a zero-cost host op whose
+  only job is to ride the executed schedule so the recorded corpus, the
+  surrogate featurizer (learn/features.py) and the driver's
+  ``perf.chunked`` provenance can read the searched count back out.
+
+Numerics: ``chunks=1`` IS the original op (the unchunked menu entry is
+the op itself — bit-identical by construction); ``chunks>1`` re-associates
+the accumulation across chunk boundaries and is held to the driver's
+allclose result-integrity gate, exactly the fused path's ``tiles>1`` rule
+(docs/performance.md, "Chunked overlap").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence as Seq
+
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import (
+    ChoiceOp,
+    CompoundOp,
+    CpuOp,
+    DeviceOp,
+    OpBase,
+    register_kind,
+)
+
+# the directive marker: a ChunkDirective is named f"{base}{CHUNK_MARK}{n}".
+# learn/features.py duplicates this string (importing nothing from here so
+# the featurizer stays jax-free); tests/test_chunking.py asserts they agree.
+CHUNK_MARK = ".chunk.c"
+
+
+@register_kind("chunk")
+class ChunkDirective(CpuOp):
+    """The executed chunk directive: a no-op host op named
+    ``<base>.chunk.c<N>`` whose only effect is to ride the schedule so the
+    chosen chunk count is readable from the executed op list — the exact
+    shape of the fusion backend's ``fuse_tile.tN``.  A CpuOp so it costs
+    nothing in the traced program."""
+
+    def __init__(self, base: str, chunks: int):
+        super().__init__(f"{base}{CHUNK_MARK}{int(chunks)}")
+        self._base = base
+        self._chunks = int(chunks)
+
+    def base(self) -> str:
+        return self._base
+
+    def chunks(self) -> int:
+        return self._chunks
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "name": self.name(),
+                "base": self._base, "chunks": self._chunks}
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "ChunkDirective":
+        return cls(j["base"], int(j["chunks"]))
+
+
+class ChunkedOp(CompoundOp):
+    """One chunked expansion of an audited op: the ``chunk.cN`` directive
+    followed by the op's ``split(n)`` partials chained serially (every
+    partial reads the buffer version its predecessor wrote — the combine
+    is folded into the accumulating updates).  An ordinary CompoundOp:
+    the scheduler inlines it through ``Graph.clone_but_expand``, so the
+    partials are first-class vertices the search interleaves other work
+    between.
+
+    ``est_hidden_us`` carries the roofline's hidden-comm upper bound for
+    this count (``bench/roofline.py::hidden_comm_bound_us``) into the
+    driver's ``perf.chunked`` provenance; ``None`` when the menu was
+    built un-priced (tests, relaxed smoke menus)."""
+
+    def __init__(self, op: DeviceOp, chunks: int,
+                 est_hidden_us: Optional[float] = None):
+        super().__init__(f"{op.name()}.chunked.c{int(chunks)}")
+        if int(chunks) < 2:
+            raise ValueError("ChunkedOp needs chunks >= 2 (1 = the op itself)")
+        if not op.chunkable():
+            raise ValueError(f"op {op.name()!r} does not declare chunkable()")
+        self._op = op
+        self._chunks = int(chunks)
+        self.est_hidden_us = est_hidden_us
+
+    def base_op(self) -> DeviceOp:
+        return self._op
+
+    def chunks(self) -> int:
+        return self._chunks
+
+    def graph(self) -> Graph:
+        g = Graph()
+        prev: OpBase = ChunkDirective(self._op.name(), self._chunks)
+        g.start_then(prev)
+        for part in self._op.split(self._chunks):
+            g.then(prev, part)
+            prev = part
+        g.then_finish(prev)
+        return g
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "name": self.name(),
+                "base": self._op.name(), "chunks": self._chunks}
+
+
+class ChunkChoice(ChoiceOp):
+    """The chunk-count menu for an op that has no pre-existing kernel
+    ChoiceOp to extend: the op unchanged (chunks=1) vs its chunked
+    expansions, named ``<op>.chunks`` so the choice vertex never collides
+    with an executed op name.  Models with an existing menu (the attn
+    kernel choice, the MoE FFN choice) append :class:`ChunkedOp` variants
+    to it directly instead."""
+
+    def __init__(self, op: DeviceOp, variants: Seq[ChunkedOp]):
+        super().__init__(op.name() + ".chunks")
+        self._op = op
+        self._variants = list(variants)
+        self.chunk_menu = menu_info(
+            op.name(), [1] + [v.chunks() for v in self._variants],
+            {v.chunks(): v.est_hidden_us for v in self._variants})
+
+    def choices(self) -> List[OpBase]:
+        return [self._op] + list(self._variants)
+
+
+def menu_info(base: str, counts: Seq[int],
+              est: Optional[Dict[int, Optional[float]]] = None
+              ) -> Dict[str, Any]:
+    """The ``chunk_menu`` attribute choice nodes carry for provenance:
+    ``base`` is the name the chunked variants wrap (matching the keys
+    :func:`chunks_of` extracts from an executed schedule), ``counts`` the
+    pruned menu, ``est_hidden_us`` the per-count roofline bound."""
+    return {"base": base,
+            "counts": sorted({int(c) for c in counts} | {1}),
+            "est_hidden_us": {int(n): e for n, e in (est or {}).items()
+                              if e is not None}}
+
+
+def pow2_counts(extent: Optional[int], cap: int = 8) -> List[int]:
+    """The structurally valid chunk counts of a split axis: 1 plus every
+    power of two ``<= cap`` dividing ``extent`` — THE ``chunk_counts()``
+    recipe every audited model shares.  ``extent=None`` (the op was built
+    without its split-axis size) returns ``[1]``: an unknown extent is
+    not chunkable, never guessed."""
+    out = [1]
+    if not extent:
+        return out
+    n = 2
+    while n <= cap and extent % n == 0:
+        out.append(n)
+        n *= 2
+    return out
+
+
+def chunk_variants(op: DeviceOp, counts: Seq[int],
+                   est: Optional[Dict[int, float]] = None
+                   ) -> List[ChunkedOp]:
+    """``ChunkedOp`` alternatives of ``op`` for the pruned ``counts``
+    (entries ``<= 1`` are skipped — 1 is the op itself)."""
+    est = est or {}
+    return [ChunkedOp(op, n, est_hidden_us=est.get(n))
+            for n in sorted({int(c) for c in counts}) if n > 1]
+
+
+def chunks_of(order) -> Dict[str, int]:
+    """The chunk counts an executed schedule carries, by directive base
+    name (``{}`` for an unchunked schedule) — parsed from the
+    ``<base>.chunk.c<N>`` directives, the read-back twin of
+    ``runtime/fused.py::tiles_of``."""
+    out: Dict[str, int] = {}
+    for op in order:
+        name = op.name() if hasattr(op, "name") else ""
+        i = name.rfind(CHUNK_MARK)
+        if i < 0:
+            continue
+        try:
+            out[name[:i]] = max(1, int(name[i + len(CHUNK_MARK):]))
+        except ValueError:
+            continue
+    return out
+
+
+def hidden_comm_measured_us(ops, attrib) -> float:
+    """Measured hidden comm of a chunked schedule: the total
+    Gantt-interval overlap between transfer units and the chunk-partial
+    units, from the attribution profiler's stepped timeline
+    (obs/attrib — durations measured per unit, starts reconstructed from
+    the happens-before relation).  This is the driver's
+    ``perf.chunked.hidden_comm_us.measured``: comm time that ran UNDER a
+    chunked op's partials, i.e. exactly the overlap whole-op scheduling
+    could not express.  ``ops`` is the executed op list
+    (``order.vector()``), ``attrib`` the filled
+    :class:`~tenzing_tpu.obs.attrib.analysis.Attribution` of the same
+    schedule; 0.0 for an unchunked schedule or a comm-free workload."""
+    from tenzing_tpu.bench.model import ICI_KINDS, PCIE_KINDS
+
+    chosen = chunks_of(ops)
+    if not chosen:
+        return 0.0
+    ops = list(ops)
+    part_prefixes = tuple(f"{base}.c{n}p" for base, n in chosen.items())
+    comm_kinds = set(ICI_KINDS) | set(PCIE_KINDS) | {
+        "await_transfer", "multi_await"}
+
+    def op_kind(pos: int) -> str:
+        if pos >= len(ops):
+            return ""
+        op = ops[pos]
+        base = op.unbound() if hasattr(op, "unbound") else op
+        return getattr(base, "KIND", "") or ""
+
+    parts: List = []
+    comms: List = []
+    for rec in attrib.timeline.records:
+        if rec.dur_us <= 0:
+            continue
+        if rec.name.startswith(part_prefixes):
+            parts.append((rec.start_us, rec.end_us))
+        elif any(op_kind(p) in comm_kinds for p in rec.positions):
+            comms.append((rec.start_us, rec.end_us))
+    total = 0.0
+    for cs, ce in comms:
+        for ps, pe in parts:
+            total += max(0.0, min(ce, pe) - max(cs, ps))
+    return total
+
+
+def chunk_menus(graph: Graph) -> Dict[str, Dict[str, Any]]:
+    """Every chunk menu a choice graph offers, keyed by the wrapped base
+    op name: walks vertices recursively (compound sub-graphs, choice
+    alternatives — the serdes descent) collecting the ``chunk_menu``
+    attribute the chunk-aware choice nodes carry.  The driver's
+    ``perf.chunked`` block reports these next to what the search chose."""
+    menus: Dict[str, Dict[str, Any]] = {}
+    seen: set = set()
+
+    def visit(op: OpBase) -> None:
+        key = id(op)
+        if key in seen:
+            return
+        seen.add(key)
+        menu = getattr(op, "chunk_menu", None)
+        if isinstance(menu, dict) and "base" in menu:
+            menus[menu["base"]] = menu
+        if isinstance(op, CompoundOp):
+            for v in op.graph().vertices():
+                visit(v)
+        if isinstance(op, ChoiceOp):
+            for c in op.choices():
+                visit(c)
+
+    for v in graph.vertices():
+        visit(v)
+    return menus
+
+
+__all__ = [
+    "CHUNK_MARK", "ChunkDirective", "ChunkedOp", "ChunkChoice",
+    "chunk_variants", "chunks_of", "chunk_menus", "menu_info",
+    "hidden_comm_measured_us", "pow2_counts",
+]

@@ -236,6 +236,37 @@ class DeviceOp(OpBase):
         offers the trivial single-tile kernel."""
         return None
 
+    # -- op-chunking protocol (core/chunking.py) ---------------------------
+    def chunkable(self) -> bool:
+        """True when this op can expand into ``n`` partial ops plus a
+        combine via :meth:`split` — the T3-style fine-grained-overlap
+        protocol (core/chunking.py), the chunking sibling of the
+        megakernel ``fusible()/fuse_tiling()`` audit above.  Opt-in per op
+        class: chunked variants only ever enter a choice menu for ops
+        that declare it, so an un-audited op can never be silently
+        re-associated."""
+        return False
+
+    def chunk_counts(self) -> List[int]:
+        """Structurally valid chunk counts (always contains 1): the
+        counts :meth:`split` accepts — typically powers of two dividing
+        the op's split-axis extent.  Validity only; profitability is the
+        roofline's question (``bench/roofline.py::prune_chunkings``)."""
+        return [1]
+
+    def split(self, n: int) -> List["DeviceOp"]:
+        """This op as ``n`` partial ops (plus a combine where the partials
+        do not already fold into an accumulating update), executed in list
+        order: :class:`~tenzing_tpu.core.chunking.ChunkedOp` chains them
+        serially, because every partial reads the buffer version its
+        predecessor wrote (read-modify-write under the executor's SSA
+        buffer semantics) — the schedule freedom chunking buys is OTHER
+        ops interleaving between the partials, e.g. a transfer posting
+        after the head chunks of its producer."""
+        raise NotImplementedError(
+            f"{type(self).__name__} declares no split() — chunkable() ops "
+            "must implement the chunking protocol")
+
 
 class BoundDeviceOp(BoundOp):
     """DeviceOp + Lane = executable (reference BoundGpuOp, ops_cuda.hpp:202-238).
@@ -288,6 +319,15 @@ class BoundDeviceOp(BoundOp):
 
     def fuse_tiling(self) -> Optional[Dict[str, Optional[int]]]:
         return self._op.fuse_tiling()
+
+    def chunkable(self) -> bool:
+        return self._op.chunkable()
+
+    def chunk_counts(self) -> List[int]:
+        return self._op.chunk_counts()
+
+    def split(self, n: int) -> List[DeviceOp]:
+        return self._op.split(n)
 
     def to_json(self) -> Dict[str, Any]:
         j = self._op.to_json()

@@ -55,6 +55,14 @@ _SYNC_KINDS = ("event_record", "wait_event", "event_sync", "lane_sync",
 _XFER_KINDS = ICI_KINDS + PCIE_KINDS + ("await_transfer", "multi_await")
 # menu-choice markers in op names (the ChoiceOp resolution the search made)
 _CHOICE_MARKS = (".pallas", ".xla", ".rdma", ".host", "bf16")
+# searched-directive markers (ISSUE 10): the executed chunk/tile directives
+# carry the solver's granularity decisions — without these coordinates the
+# surrogate would score a chunked schedule identically to its unchunked
+# twin and silently mis-rank both.  The strings are duplicated from
+# core/chunking.py::CHUNK_MARK and runtime/fused.py::TILE_PREFIX so this
+# featurizer stays import-light (tests/test_chunking.py asserts agreement).
+_CHUNK_MARK = ".chunk.c"
+_TILE_PREFIX = "fuse_tile.t"
 
 FEATURE_NAMES: List[str] = (
     ["n_ops", "n_device", "n_host_data", "n_sync"]
@@ -63,6 +71,12 @@ FEATURE_NAMES: List[str] = (
     + ["n_lanes", "lane_max_occ", "serial_frac"]
     + [f"n_choice_{m.lstrip('.')}" for m in _CHOICE_MARKS]
     + ["ici_bytes", "pcie_bytes", "analytic_makespan", "log_analytic"]
+    # APPEND-ONLY past this point: existing coordinates above must keep
+    # their positions so corpora featurized before an append stay
+    # consistent; a model saved under the shorter name list fails the
+    # load contract loudly (learn/model.py) instead of mis-predicting
+    + ["n_chunk_dir", "sum_chunk_counts", "n_fuse_tile_dir",
+       "sum_fuse_tiles"]
 )
 
 
@@ -94,6 +108,7 @@ def featurize(
     lane_occ: Dict[int, int] = {}
     choice_counts = {m: 0 for m in _CHOICE_MARKS}
     ici_bytes = pcie_bytes = 0.0
+    n_chunk_dir = sum_chunks = n_tile_dir = sum_tiles = 0
     for op in seq:
         kind = getattr(op, "KIND", "")
         if kind in kind_counts:
@@ -111,6 +126,22 @@ def featurize(
         for m in _CHOICE_MARKS:
             if m in name:
                 choice_counts[m] += 1
+        # searched-directive markers: count directives only (a partial's
+        # name carries ".cNpJ", not the directive mark, so a chunked
+        # schedule contributes one unit per chunked op, not per partial)
+        i = name.rfind(_CHUNK_MARK)
+        if i >= 0:
+            try:
+                sum_chunks += max(1, int(name[i + len(_CHUNK_MARK):]))
+                n_chunk_dir += 1
+            except ValueError:
+                pass
+        elif name.startswith(_TILE_PREFIX):
+            try:
+                sum_tiles += max(1, int(name[len(_TILE_PREFIX):]))
+                n_tile_dir += 1
+            except ValueError:
+                pass
         sz = float(sum(nbytes.get(n, 0) for n in _reads(op)))
         if kind in ICI_KINDS:
             ici_bytes += sz
@@ -127,5 +158,7 @@ def featurize(
     out += [float(choice_counts[m]) for m in _CHOICE_MARKS]
     out += [ici_bytes, pcie_bytes, makespan,
             math.log(max(makespan, 1e-12))]
+    out += [float(n_chunk_dir), float(sum_chunks),
+            float(n_tile_dir), float(sum_tiles)]
     assert len(out) == len(FEATURE_NAMES)
     return out

@@ -134,6 +134,65 @@ class ExpertFFN(DeviceOp):
         y = self._mlp(x.reshape(n * cap, d), w1, w2).astype(x.dtype)
         return {f"ffn_out_{self._c}": y.reshape(n, cap, d)}
 
+    # -- op-chunking protocol (core/chunking.py, T3): the expert MLP splits
+    # over the source-shard rows of the received slot table (the token
+    # axis), each partial folding its row slice into the output — so the
+    # combine all-to-all (or another chunk's dispatch) can post against the
+    # tail partials instead of waiting for the whole FFN.  XLA only: the
+    # Pallas subclass owns its internal blocking.
+    def chunkable(self) -> bool:
+        return True
+
+    def chunk_counts(self) -> List[int]:
+        from tenzing_tpu.core.chunking import pow2_counts
+
+        return pow2_counts(self._args.n_ep)
+
+    def split(self, n: int) -> List["ExpertFFNPartial"]:
+        e = self._args.n_ep
+        if n < 1 or e % n:
+            raise ValueError(f"{e} slot-table rows do not split {n} ways")
+        return [ExpertFFNPartial(f"{self.name()}.c{n}p{j}", self._c,
+                                 self._args, j, n)
+                for j in range(n)]
+
+
+class ExpertFFNPartial(ExpertFFN):
+    """Partial ``j`` of an ``n``-way token split of :class:`ExpertFFN`:
+    the MLP over its source-shard row slice, folded into the output buffer
+    by an accumulating slice update (read-modify-write — the combine is
+    the update chain, so other ops interleave between the partials)."""
+
+    def __init__(self, name: str, c: int, args: MoEArgs, part: int,
+                 n_parts: int):
+        super().__init__(name, c, args)
+        self._part, self._n_parts = part, n_parts
+
+    def chunkable(self) -> bool:
+        return False  # a partial never re-splits
+
+    def reads(self):
+        return super().reads() + [f"ffn_out_{self._c}"]
+
+    def apply(self, bufs, ctx):
+        from jax import lax
+
+        x = bufs[f"recv_disp_{self._c}"]  # (n_ep, C, d)
+        w1, w2 = bufs["W1"][0], bufs["W2"][0]
+        n, cap, d = x.shape
+        if n % self._n_parts:
+            # chunk validity was checked against the build-time n_ep —
+            # fail at trace time rather than slice partial rows silently
+            raise ValueError(
+                f"{self.name()}: {n} slot-table rows do not split "
+                f"{self._n_parts} ways")
+        lo = self._part * (n // self._n_parts)
+        xs = x[lo : lo + n // self._n_parts]
+        y = self._mlp(xs.reshape(-1, d), w1, w2).astype(x.dtype)
+        y = y.reshape(n // self._n_parts, cap, d)
+        return {f"ffn_out_{self._c}": lax.dynamic_update_slice_in_dim(
+            bufs[f"ffn_out_{self._c}"], y, lo, 0)}
+
 
 class ExpertFFNPallas(ExpertFFN):
     """Same MLP through the Pallas tiled-matmul kernel (ops/ffn_pallas.py)."""
@@ -146,20 +205,63 @@ class ExpertFFNPallas(ExpertFFN):
     def uses_pallas(self) -> bool:
         return True
 
+    def chunkable(self) -> bool:
+        return False  # the kernel owns its internal blocking
+
+
+def ffn_chunk_menu(args: MoEArgs, relax: bool = False):
+    """(pruned counts, {count: est hidden µs}) for one chunk's expert FFN —
+    the roofline sketch constraint (bench/roofline.py::prune_chunkings).
+    The neighboring transfer is the combine all-to-all returning the expert
+    outputs; ``relax=True`` (tests / toy shapes) keeps every structurally
+    valid count."""
+    from tenzing_tpu.bench import roofline
+
+    bpe = np.dtype(args.dtype).itemsize
+    cap = args.chunk_tokens  # capacity upper bound per (src, dst) pair
+    slots = float(args.n_ep * cap)
+    d, dff = args.d_model, args.d_ff
+    table = slots * d * bpe  # one slot-table pass (the a2a payload)
+    cost = roofline.Cost(
+        flops=4.0 * slots * d * dff,
+        hbm_bytes=2.0 * table + float(2 * d * dff * bpe))
+    return roofline.chunk_menu(
+        ExpertFFN("probe", 0, args).chunk_counts(), cost,
+        comm_us=table / (roofline.V5E_XFER_GBS * 1e9) * 1e6,
+        combine_bytes=2.0 * table, relax=relax)
+
 
 class ExpertFFNChoice(ChoiceOp):
-    """Kernel menu for chunk ``c``'s expert MLP: XLA einsums vs Pallas tiles."""
+    """Kernel menu for chunk ``c``'s expert MLP: XLA einsums vs Pallas tiles
+    (plus T3-style chunked expansions of the XLA kernel when
+    ``chunk_counts`` is given — core/chunking.py)."""
 
-    def __init__(self, name: str, c: int, args: MoEArgs):
+    def __init__(self, name: str, c: int, args: MoEArgs,
+                 chunk_counts=(), chunk_est=None):
         super().__init__(name)
         self._c = c
         self._args = args
+        self._chunks = tuple(int(n) for n in chunk_counts if int(n) > 1)
+        self._chunk_est = dict(chunk_est or {})
+        if chunk_counts:
+            from tenzing_tpu.core.chunking import menu_info
+
+            self.chunk_menu = menu_info(name + ".xla", chunk_counts,
+                                        self._chunk_est)
 
     def choices(self) -> List[OpBase]:
-        return [
+        from tenzing_tpu.core.chunking import ChunkedOp
+
+        out: List[OpBase] = [
             ExpertFFN(self.name() + ".xla", self._c, self._args),
             ExpertFFNPallas(self.name() + ".pallas", self._c, self._args),
         ]
+        out += [
+            ChunkedOp(ExpertFFN(self.name() + ".xla", self._c, self._args),
+                      n, est_hidden_us=self._chunk_est.get(n))
+            for n in self._chunks
+        ]
+        return out
 
 
 class CombineScatter(DeviceOp):
@@ -214,12 +316,19 @@ class ConcatChunks(DeviceOp):
 class MoELayer(CompoundOp):
     """The whole EP layer as one compound: ``n_chunks`` independent
     dispatch -> expert -> combine chains joined by the final concat.  With
-    ``impl_choice`` each chunk's FFN kernel is searched."""
+    ``impl_choice`` each chunk's FFN kernel is searched; ``chunk=True``
+    adds T3-style chunked expert-FFN alternatives to the menus
+    (core/chunking.py; :func:`ffn_chunk_menu` prunes the counts through
+    the roofline — ``chunk_relax`` skips the pruning, the tests mode)."""
 
-    def __init__(self, args: MoEArgs, name: str = "moe", impl_choice: bool = False):
+    def __init__(self, args: MoEArgs, name: str = "moe",
+                 impl_choice: bool = False, chunk: bool = False,
+                 chunk_relax: bool = False):
         super().__init__(name)
         self._args = args
         self._impl_choice = impl_choice
+        self._chunk = chunk
+        self._chunk_relax = chunk_relax
 
     def args(self) -> MoEArgs:
         return self._args
@@ -227,7 +336,21 @@ class MoELayer(CompoundOp):
     def graph(self) -> Graph:
         g = Graph()
         cat = ConcatChunks("moe_concat", self._args)
-        mk = ExpertFFNChoice if self._impl_choice else ExpertFFN
+        counts, est = ((), None)
+        if self._chunk:
+            counts, est = ffn_chunk_menu(self._args,
+                                         relax=self._chunk_relax)
+        if self._impl_choice:
+            mk = lambda name, c_, a_: ExpertFFNChoice(
+                name, c_, a_, chunk_counts=counts, chunk_est=est)
+        elif any(int(n) > 1 for n in counts):
+            from tenzing_tpu.core.chunking import ChunkChoice, chunk_variants
+
+            def mk(name, c_, a_):
+                op = ExpertFFN(name, c_, a_)
+                return ChunkChoice(op, chunk_variants(op, counts, est))
+        else:
+            mk = ExpertFFN
         for c in range(self._args.n_chunks):
             pack = DispatchPack(f"pack_{c}", c, self._args)
             disp = AllToAllStart(

@@ -147,6 +147,66 @@ class ExpertFFNPipe(DeviceOp):
         return {f"out{self._sfx}_{self._c}": flatten_face(y, shape)}
 
 
+    # -- op-chunking protocol (core/chunking.py, T3): the expert MLP splits
+    # over the expert axis into n partial FFNs, each updating its expert
+    # slice of the output slot table — so the combine-side DMA (or another
+    # chunk's transfer) can interleave with the tail partials instead of
+    # waiting for every expert.  XLA variant only: the Pallas kernel owns
+    # its internal blocking.
+    def chunkable(self) -> bool:
+        return True
+
+    def chunk_counts(self) -> List[int]:
+        from tenzing_tpu.core.chunking import pow2_counts
+
+        return pow2_counts(self._args.n_experts)
+
+    def split(self, n: int) -> List["ExpertFFNPipePartial"]:
+        e = self._args.n_experts
+        if n < 1 or e % n:
+            raise ValueError(f"{e} experts do not split {n} ways")
+        return [
+            ExpertFFNPipePartial(f"{self.name()}.c{n}p{j}", self._c,
+                                 self._args, self._cap, j, n,
+                                 "bf16" if self._sfx else "f32")
+            for j in range(n)
+        ]
+
+
+class ExpertFFNPipePartial(ExpertFFNPipe):
+    """Partial ``j`` of an ``n``-way expert split: run the MLP over its
+    expert-row slice of the received slot table and fold the result into
+    the output buffer (read-modify-write — the combine is the accumulating
+    slice update, so the partials chain serially through the buffer
+    version and the schedule interleaves OTHER ops between them)."""
+
+    def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int,
+                 part: int, n_parts: int, prec: str = "f32"):
+        super().__init__(name, c, args, cap, prec)
+        self._part, self._n_parts = part, n_parts
+
+    def chunkable(self) -> bool:
+        return False  # a partial never re-splits
+
+    def reads(self):
+        return super().reads() + [f"out{self._sfx}_{self._c}"]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+        from jax import lax
+
+        shape = _slot_shape(self._args, self._cap)
+        lo = self._part * (shape[0] // self._n_parts)
+        hi = lo + shape[0] // self._n_parts
+        raw = unflatten_face(bufs[f"recv{self._sfx}_{self._c}"], shape)
+        x3 = raw.astype(jnp.float32) if self._sfx else raw
+        y = self._mlp(x3[lo:hi], bufs["W1"][lo:hi], bufs["W2"][lo:hi])
+        y = y.astype(jnp.bfloat16 if self._sfx else x3.dtype)
+        cur = unflatten_face(bufs[f"out{self._sfx}_{self._c}"], shape)
+        upd = lax.dynamic_update_slice_in_dim(cur, y.astype(cur.dtype), lo, 0)
+        return {f"out{self._sfx}_{self._c}": flatten_face(upd, shape)}
+
+
 class ExpertFFNPipePallas(ExpertFFNPipe):
     """Same per-expert MLP through the Pallas kernel (one expert's weight pair
     + one row tile per program in VMEM)."""
@@ -159,15 +219,50 @@ class ExpertFFNPipePallas(ExpertFFNPipe):
     def uses_pallas(self) -> bool:
         return True
 
+    def chunkable(self) -> bool:
+        return False  # the kernel owns its internal blocking
+
+
+def ffn_chunk_menu(args: MoEPipeArgs, cap: int, relax: bool = False):
+    """(pruned counts, {count: est hidden µs}) for one chunk's expert FFN —
+    the roofline sketch constraint (bench/roofline.py::prune_chunkings).
+    The neighboring transfer is the combine-side staging DMA of the output
+    slot table; ``relax=True`` (CPU smoke / library tests) keeps every
+    structurally-valid count so toy shapes stay searchable."""
+    from tenzing_tpu.bench import roofline
+
+    bpe = np.dtype(args.dtype).itemsize
+    e, d, dff = args.n_experts, args.d_model, args.d_ff
+    slots = float(e * cap)
+    table = slots * d * bpe  # one slot-table pass
+    cost = roofline.Cost(
+        flops=4.0 * slots * d * dff,
+        hbm_bytes=2.0 * table + float(e * 2 * d * dff * bpe))
+    # combine cost: every extra partial re-presents the output table
+    # (read + write of the RMW slice update)
+    return roofline.chunk_menu(
+        ExpertFFNPipe("probe", 0, args, cap).chunk_counts(), cost,
+        comm_us=table / (roofline.V5E_XFER_GBS * 1e9) * 1e6,
+        combine_bytes=2.0 * table, relax=relax)
+
 
 class ExpertFFNPipeChoice(ChoiceOp):
     def __init__(self, name: str, c: int, args: MoEPipeArgs, cap: int,
-                 prec: str = "f32"):
+                 prec: str = "f32", chunk_counts=(), chunk_est=None):
         super().__init__(name)
         self._c, self._args, self._cap, self._prec = c, args, cap, prec
+        self._chunks = tuple(int(n) for n in chunk_counts if int(n) > 1)
+        self._chunk_est = dict(chunk_est or {})
+        if chunk_counts:
+            from tenzing_tpu.core.chunking import menu_info
+
+            self.chunk_menu = menu_info(name + ".xla", chunk_counts,
+                                        self._chunk_est)
 
     def choices(self) -> List[OpBase]:
-        return [
+        from tenzing_tpu.core.chunking import ChunkedOp
+
+        out: List[OpBase] = [
             ExpertFFNPipe(self.name() + ".xla", self._c, self._args, self._cap,
                           self._prec),
             ExpertFFNPipePallas(
@@ -175,6 +270,15 @@ class ExpertFFNPipeChoice(ChoiceOp):
                 self._prec
             ),
         ]
+        # chunked alternatives of the XLA expert MLP: ordinary menu entries
+        # the solvers pick like any kernel (core/chunking.py)
+        out += [
+            ChunkedOp(ExpertFFNPipe(self.name() + ".xla", self._c,
+                                    self._args, self._cap, self._prec),
+                      n, est_hidden_us=self._chunk_est.get(n))
+            for n in self._chunks
+        ]
+        return out
 
 
 class CombinePipe(DeviceOp):
@@ -228,17 +332,32 @@ class ConcatPipe(DeviceOp):
 
 
 def chunk_ops(args: MoEPipeArgs, c: int, cap: int, impl_choice: bool = False,
-              prec: str = "f32", engine: str = "host"):
+              prec: str = "f32", engine: str = "host",
+              op_chunk_counts=(), op_chunk_est=None):
     """The op chain for one microbatch chunk.  ``prec="bf16"`` routes the
     staged transfers through the half-width bfloat16 buffer set (op and
     buffer names carry a ``16`` suffix so both variants can coexist in one
     choice graph); ``engine="rdma"`` replaces each host round trip with a
     device-resident remote-DMA copy (ops/rdma.py — the CUDA-aware-MPI
-    analog; the host buffers stay declared but untouched)."""
+    analog; the host buffers stay declared but untouched).
+    ``op_chunk_counts``/``op_chunk_est`` add T3-style chunked expert-FFN
+    alternatives to the menus (core/chunking.py; :func:`ffn_chunk_menu`)."""
     if engine not in ("host", "rdma"):
         raise ValueError(f"unknown transfer engine {engine!r}")
     s = "16" if prec == "bf16" else ""
-    mk = ExpertFFNPipeChoice if impl_choice else ExpertFFNPipe
+    counts = tuple(n for n in (op_chunk_counts or ()) if int(n) > 1)
+    if impl_choice:
+        mk = lambda name, c_, a_, cap_, p_: ExpertFFNPipeChoice(
+            name, c_, a_, cap_, p_, chunk_counts=op_chunk_counts,
+            chunk_est=op_chunk_est)
+    elif counts:
+        from tenzing_tpu.core.chunking import ChunkChoice, chunk_variants
+
+        def mk(name, c_, a_, cap_, p_):
+            op = ExpertFFNPipe(name, c_, a_, cap_, p_)
+            return ChunkChoice(op, chunk_variants(op, counts, op_chunk_est))
+    else:
+        mk = ExpertFFNPipe
     pack = DispatchPackPipe(f"pack{s}_{c}", c, args, cap, prec)
     if engine == "rdma":
         from tenzing_tpu.ops.rdma import RdmaCopyStart
@@ -268,16 +387,20 @@ class ChunkChain(CompoundOp):
     fixed staging precision — the unit the staging ChoiceOp selects."""
 
     def __init__(self, c: int, args: MoEPipeArgs, cap: int,
-                 impl_choice: bool, prec: str, engine: str = "host"):
+                 impl_choice: bool, prec: str, engine: str = "host",
+                 op_chunk_counts=(), op_chunk_est=None):
         super().__init__(f"chain_{c}.{prec}-{engine}")
         self._c, self._args, self._cap = c, args, cap
         self._impl_choice, self._prec = impl_choice, prec
         self._engine = engine
+        self._op_chunk_counts = tuple(op_chunk_counts)
+        self._op_chunk_est = dict(op_chunk_est or {})
 
     def graph(self) -> Graph:
         g = Graph()
         ops = chunk_ops(self._args, self._c, self._cap, self._impl_choice,
-                        self._prec, self._engine)
+                        self._prec, self._engine,
+                        self._op_chunk_counts, self._op_chunk_est)
         g.start_then(ops[0])
         for a, b in zip(ops, ops[1:]):
             g.then(a, b)
@@ -293,15 +416,19 @@ class StagingChoice(ChoiceOp):
     the combine-side outputs to bf16; whether the halved DMA bytes win is the
     solver's question."""
 
-    def __init__(self, c: int, args: MoEPipeArgs, cap: int, impl_choice: bool):
+    def __init__(self, c: int, args: MoEPipeArgs, cap: int, impl_choice: bool,
+                 op_chunk_counts=(), op_chunk_est=None):
         super().__init__(f"chain_{c}")
         self._c, self._args, self._cap = c, args, cap
         self._impl_choice = impl_choice
+        self._op_chunk_counts = tuple(op_chunk_counts)
+        self._op_chunk_est = dict(op_chunk_est or {})
 
     def choices(self) -> List[OpBase]:
         return [
             ChunkChain(self._c, self._args, self._cap, self._impl_choice,
-                       prec, engine)
+                       prec, engine, self._op_chunk_counts,
+                       self._op_chunk_est)
             for prec in ("f32", "bf16")
             for engine in ("host", "rdma")
         ]
@@ -312,23 +439,33 @@ PHASES = ("start", "pack", "spilld", "fetchd", "xferd", "awaitd", "ffn",
 
 
 def build_graph(args: MoEPipeArgs, cap: int, impl_choice: bool = False,
-                staging: str = "f32", engine: str = "host") -> Graph:
+                staging: str = "f32", engine: str = "host",
+                chunk: bool = False, chunk_relax: bool = False) -> Graph:
     """``n_chunks`` independent chains joined by the final concat (the
     multi-chip MoELayer's shape with the all-to-alls replaced by host round
     trips).  ``staging``: "f32" or "bf16" wires that variant directly;
     "choice" wraps each chunk's chain in a :class:`StagingChoice` so the
     solver also searches the transfer precision (buffers must come from
-    ``make_pipe_buffers(..., staging="choice")``)."""
+    ``make_pipe_buffers(..., staging="choice")``).
+
+    ``chunk=True`` adds T3-style chunked expert-FFN alternatives to each
+    chunk chain's menus (core/chunking.py; :func:`ffn_chunk_menu` prunes
+    the counts through the roofline — ``chunk_relax`` skips the pruning,
+    the CPU-smoke/tests mode)."""
+    counts, est = ((), None)
+    if chunk:
+        counts, est = ffn_chunk_menu(args, cap, relax=chunk_relax)
     g = Graph()
     cat = ConcatPipe("concat", args)
     for c in range(args.n_chunks):
         if staging == "choice":
-            chain = StagingChoice(c, args, cap, impl_choice)
+            chain = StagingChoice(c, args, cap, impl_choice, counts, est)
             g.start_then(chain)
             g.then(chain, cat)
             continue
         ops = chunk_ops(args, c, cap, impl_choice, prec=staging,
-                        engine=engine)
+                        engine=engine, op_chunk_counts=counts,
+                        op_chunk_est=est)
         g.start_then(ops[0])
         for a, b in zip(ops, ops[1:]):
             g.then(a, b)

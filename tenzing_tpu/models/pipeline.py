@@ -95,9 +95,10 @@ class StageCompute(DeviceOp):
     stage computes every tick — SPMD; ticks whose slot holds no live
     microbatch produce garbage that is never collected)."""
 
-    def __init__(self, name: str, v: int, t: int):
+    def __init__(self, name: str, v: int, t: int, mb_rows: int = None):
         super().__init__(name)
         self._v, self._t = v, t
+        self._mb = mb_rows  # per-shard activation rows, for chunk_counts
 
     def reads(self):
         return [_act(self._v, self._t), "W"]
@@ -116,6 +117,75 @@ class StageCompute(DeviceOp):
                 jnp.dot(act, w, preferred_element_type=jnp.float32)
             ).astype(act.dtype)
         }
+
+    # -- op-chunking protocol (core/chunking.py, T3): the stage GEMM splits
+    # over the activation rows into n partial GEMMs, each folding its row
+    # slice into the outgoing buffer — so the stage send (the rotate post)
+    # can launch against the tail partials instead of waiting for the
+    # whole stage.
+    def chunkable(self) -> bool:
+        return True
+
+    def chunk_counts(self) -> List[int]:
+        # validity only: powers of two dividing the per-shard row count
+        # (the mb_size rows every stage computes per tick); an op built
+        # without mb_rows is not chunkable — never guess the extent
+        from tenzing_tpu.core.chunking import pow2_counts
+
+        return pow2_counts(self._mb)
+
+    def split(self, n: int) -> List["StageComputePartial"]:
+        rows = self._mb
+        if rows is None:
+            raise ValueError(
+                f"{self.name()}: split() needs the mb_rows extent")
+        if n < 1 or rows % n:
+            raise ValueError(f"{rows} activation rows do not split {n} ways")
+        return [StageComputePartial(f"{self.name()}.c{n}p{j}", self._v,
+                                    self._t, j, n, mb_rows=rows)
+                for j in range(n)]
+
+
+class StageComputePartial(StageCompute):
+    """Partial ``j`` of an ``n``-way row split of :class:`StageCompute`:
+    the stage GEMM over its row slice of the resident activation, folded
+    into ``out_v`` by an accumulating slice update (read-modify-write —
+    the combine is the update chain, so the rotate post or another
+    chain's compute interleaves between the partials)."""
+
+    def __init__(self, name: str, v: int, t: int, part: int, n_parts: int,
+                 mb_rows: int = None):
+        super().__init__(name, v, t, mb_rows=mb_rows)
+        self._part, self._n_parts = part, n_parts
+
+    def chunkable(self) -> bool:
+        return False  # a partial never re-splits
+
+    def reads(self):
+        return super().reads() + [f"out_{self._v}"]
+
+    def apply(self, bufs, ctx):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        w = bufs["W"][0]
+        act = bufs[_act(self._v, self._t)]
+        rows = act.shape[0]
+        if rows % self._n_parts:
+            # chunk validity was checked against the build-time mb_rows;
+            # a sharded layout can hand this op fewer runtime rows — fail
+            # at trace time rather than slice 0/partial rows silently
+            raise ValueError(
+                f"{self.name()}: {rows} runtime rows do not split "
+                f"{self._n_parts} ways")
+        lo = self._part * (rows // self._n_parts)
+        xs = lax.dynamic_slice_in_dim(act, lo, rows // self._n_parts, 0)
+        y = jax.nn.gelu(
+            jnp.dot(xs, w, preferred_element_type=jnp.float32)
+        ).astype(act.dtype)
+        return {f"out_{self._v}": lax.dynamic_update_slice_in_dim(
+            bufs[f"out_{self._v}"], y, lo, 0)}
 
 
 class Collect(DeviceOp):
@@ -217,14 +287,42 @@ def _forward_chain(
     return comp, prev_collect
 
 
+def stage_chunk_menu(args: PipelineArgs, relax: bool = False):
+    """(pruned counts, {count: est hidden µs}) for one stage-tick GEMM —
+    the roofline sketch constraint (bench/roofline.py::prune_chunkings).
+    The neighboring transfer is the stage send (the ICI rotate of the
+    tick's output rows); ``relax=True`` (tests / toy shapes) keeps every
+    structurally valid count."""
+    from tenzing_tpu.bench import roofline
+
+    bpe = np.dtype(args.dtype).itemsize
+    b, d = args.mb_size, args.d_model
+    act = float(b * d * bpe)  # one shard's activation rows
+    cost = roofline.Cost(flops=2.0 * b * d * d,
+                         hbm_bytes=2.0 * act + float(d * d * bpe))
+    return roofline.chunk_menu(
+        StageCompute("probe", 0, 0, mb_rows=args.mb_size).chunk_counts(),
+        cost, comm_us=act / (roofline.V5E_XFER_GBS * 1e9) * 1e6,
+        combine_bytes=2.0 * act, relax=relax)
+
+
 class Pipeline(CompoundOp):
     """The whole pipelined forward as one compound op: ``n_chains``
     independent tick chains, each with the post/wait-split rotate, joined by
-    the final interleave."""
+    the final interleave.
 
-    def __init__(self, args: PipelineArgs, name: str = "pipeline"):
+    ``chunk=True`` wraps each tick's stage GEMM in a
+    :class:`~tenzing_tpu.core.chunking.ChunkChoice` so the solvers search
+    T3-style row splits whose tail partials the rotate post overlaps
+    (core/chunking.py; :func:`stage_chunk_menu` prunes the counts through
+    the roofline — ``chunk_relax`` skips the pruning, the tests mode)."""
+
+    def __init__(self, args: PipelineArgs, name: str = "pipeline",
+                 chunk: bool = False, chunk_relax: bool = False):
         super().__init__(name)
         self._args = args
+        self._chunk = chunk
+        self._chunk_relax = chunk_relax
 
     def args(self) -> PipelineArgs:
         return self._args
@@ -232,11 +330,25 @@ class Pipeline(CompoundOp):
     def graph(self) -> Graph:
         a = self._args
         g = Graph()
+        counts, est = ((), None)
+        if self._chunk:
+            counts, est = stage_chunk_menu(a, relax=self._chunk_relax)
+
+        def mk(vv, tt):
+            step = StageCompute(f"compute_{vv}_{tt}", vv, tt,
+                                mb_rows=a.mb_size)
+            if any(int(n) > 1 for n in counts):
+                from tenzing_tpu.core.chunking import (
+                    ChunkChoice,
+                    chunk_variants,
+                )
+
+                return ChunkChoice(step, chunk_variants(step, counts, est))
+            return step
+
         inter = InterleaveY("pp_interleave", a)
         for v in range(a.n_chains):
-            _comp, last_collect = _forward_chain(
-                g, v, a, lambda vv, tt: StageCompute(f"compute_{vv}_{tt}", vv, tt)
-            )
+            _comp, last_collect = _forward_chain(g, v, a, mk)
             g.then(last_collect, inter)
         g.then_finish(inter)
         return g

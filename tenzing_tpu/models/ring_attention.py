@@ -272,6 +272,43 @@ class BlockAttnStep(AttnStep):
         )
         return {"acc": acc, "m_run": m, "l_run": l}
 
+    # -- op-chunking protocol (core/chunking.py, T3): the fold splits over
+    # the K/V block axis into n sub-folds of seq_local/n columns each —
+    # a sub-fold IS a finer BlockAttnStep (the online-softmax state chain
+    # is the combine), so a neighboring op can interleave with the tail
+    # sub-folds instead of waiting for the whole block.  XLA fold only:
+    # the Pallas kernels own their internal blocking (and the partitioner
+    # excludes nested kernels anyway).
+    def chunkable(self) -> bool:
+        return True
+
+    def chunk_counts(self) -> List[int]:
+        from tenzing_tpu.core.chunking import pow2_counts
+
+        return pow2_counts(self._args.seq_local)
+
+    def split(self, n: int) -> List["BlockAttnStep"]:
+        from dataclasses import replace
+
+        blk = self._args.seq_local
+        if n < 1 or blk % n:
+            raise ValueError(f"{blk} K/V columns do not split {n} ways")
+        sub = replace(self._args, seq_local=blk // n)
+        # sub-fold j of block s slices K/V at s*blk + j*(blk//n): the same
+        # dynamic_slice arithmetic, one power of two finer
+        return [BlockAttnSubFold(f"{self.name()}.c{n}p{j}", self._s * n + j,
+                                 sub)
+                for j in range(n)]
+
+
+class BlockAttnSubFold(BlockAttnStep):
+    """A :meth:`BlockAttnStep.split` sub-fold: the same op one power of
+    two finer (the online-softmax state chain is the combine), except it
+    never re-splits — partials are leaves of the chunking protocol."""
+
+    def chunkable(self) -> bool:
+        return False
+
 
 class BlockAttnStepPallas(BlockAttnStep):
     """Blocked step with the Pallas MXU kernel update."""
@@ -280,6 +317,9 @@ class BlockAttnStepPallas(BlockAttnStep):
 
     def uses_pallas(self) -> bool:
         return True
+
+    def chunkable(self) -> bool:
+        return False  # the kernel owns its internal blocking
 
 
 class BlockAttnStepPallasBf16(BlockAttnStep):
@@ -290,21 +330,67 @@ class BlockAttnStepPallasBf16(BlockAttnStep):
     def uses_pallas(self) -> bool:
         return True
 
+    def chunkable(self) -> bool:
+        return False
+
+
+def fold_chunk_menu(args: RingAttnArgs, relax: bool = False):
+    """(pruned counts, {count: est hidden µs}) for one block fold — the
+    roofline sketch constraint (bench/roofline.py::prune_chunkings).  The
+    single-chip blocked fold has NO neighboring transfer to hide
+    (``comm_us=0``), so the honest full-size menu prunes every n>1 and the
+    driver's ``perf.chunked`` block says so; ``relax=True`` (the CPU smoke
+    and the library tests — the ``min_tile_bytes=0`` convention of
+    tests/test_fused.py) keeps every structurally-valid count so the
+    machinery is searchable on toy shapes."""
+    from tenzing_tpu.bench import roofline
+
+    bpe = np.dtype(args.dtype).itemsize
+    b, d, blk = args.batch, args.head_dim, args.seq_local
+    nq = args.n_devices * blk  # all queries fold against each block
+    state = 6.0 * b * nq * d * bpe  # read+write acc/m_run/l_run
+    cost = roofline.Cost(flops=4.0 * b * nq * blk * d,
+                         hbm_bytes=state + 2.0 * b * blk * d * bpe)
+    # combine cost: every extra sub-fold re-presents the full softmax
+    # state (the accumulating RMW is the combine)
+    return roofline.chunk_menu(
+        BlockAttnStep("probe", 0, args).chunk_counts(), cost,
+        comm_us=0.0, combine_bytes=state, relax=relax)
+
 
 class BlockAttnChoice(ChoiceOp):
-    def __init__(self, name: str, s: int, args: RingAttnArgs):
+    def __init__(self, name: str, s: int, args: RingAttnArgs,
+                 chunk_counts=(), chunk_est=None):
         super().__init__(name)
         self._s = s
         self._args = args
+        self._chunks = tuple(int(c) for c in chunk_counts if int(c) > 1)
+        self._chunk_est = dict(chunk_est or {})
+        if chunk_counts:
+            from tenzing_tpu.core.chunking import menu_info
+
+            self.chunk_menu = menu_info(name + ".xla", chunk_counts,
+                                        self._chunk_est)
 
     def choices(self) -> List[OpBase]:
-        return [
+        from tenzing_tpu.core.chunking import ChunkedOp
+
+        out: List[OpBase] = [
             BlockAttnStep(self.name() + ".xla", self._s, self._args),
             BlockAttnStepPallas(self.name() + ".pallas", self._s, self._args),
             BlockAttnStepPallasBf16(
                 self.name() + ".pallas_bf16", self._s, self._args
             ),
         ]
+        # chunked alternatives of the XLA fold: ordinary menu entries the
+        # solvers pick like any kernel (core/chunking.py)
+        out += [
+            ChunkedOp(BlockAttnStep(self.name() + ".xla", self._s,
+                                    self._args),
+                      n, est_hidden_us=self._chunk_est.get(n))
+            for n in self._chunks
+        ]
+        return out
 
 
 class FusedBlockAttn(DeviceOp):
@@ -351,22 +437,45 @@ class FusedBlockAttnBf16(FusedBlockAttn):
     BF16 = True
 
 
+def _mk_block_step(name: str, s: int, args: RingAttnArgs, impl_choice: bool,
+                   chunk_counts, chunk_est) -> OpBase:
+    """One block fold vertex: the kernel ChoiceOp (optionally extended
+    with chunked alternatives), a bare step wrapped in a
+    :class:`~tenzing_tpu.core.chunking.ChunkChoice` when only chunking is
+    searched, or the plain step."""
+    if impl_choice:
+        return BlockAttnChoice(name, s, args, chunk_counts=chunk_counts,
+                               chunk_est=chunk_est)
+    step = BlockAttnStep(name, s, args)
+    counts = [c for c in (chunk_counts or ()) if int(c) > 1]
+    if counts:
+        from tenzing_tpu.core.chunking import ChunkChoice, chunk_variants
+
+        return ChunkChoice(step, chunk_variants(step, counts, chunk_est))
+    return step
+
+
 class BlockChain(CompoundOp):
     """The per-block fold chain as one expandable vertex — the staged
     alternative the fused kernel competes with inside
     :class:`AttnEngineChoice` (the HostRoundTrip-in-TransferChoice
     precedent, models/halo_pipeline.py)."""
 
-    def __init__(self, name: str, args: RingAttnArgs, impl_choice: bool):
+    def __init__(self, name: str, args: RingAttnArgs, impl_choice: bool,
+                 chunk_counts=(), chunk_est=None):
         super().__init__(name)
         self._args = args
         self._impl_choice = impl_choice
+        self._chunk_counts = tuple(chunk_counts)
+        self._chunk_est = dict(chunk_est or {})
 
     def graph(self) -> Graph:
         g = Graph()
         n = self._args.n_devices
-        mk = BlockAttnChoice if self._impl_choice else BlockAttnStep
-        attns = [mk(f"attn_{s}", s, self._args) for s in range(n)]
+        attns = [_mk_block_step(f"attn_{s}", s, self._args,
+                                self._impl_choice, self._chunk_counts,
+                                self._chunk_est)
+                 for s in range(n)]
         g.start_then(attns[0])
         for s in range(1, n):
             g.then(attns[s - 1], attns[s])
@@ -380,14 +489,18 @@ class AttnEngineChoice(ChoiceOp):
     flash (f32 or bf16 MXU inputs) — kernel granularity is itself a
     scheduling decision the solver owns."""
 
-    def __init__(self, args: RingAttnArgs, impl_choice: bool):
+    def __init__(self, args: RingAttnArgs, impl_choice: bool,
+                 chunk_counts=(), chunk_est=None):
         super().__init__("attn_blocks")
         self._args = args
         self._impl_choice = impl_choice
+        self._chunk_counts = tuple(chunk_counts)
+        self._chunk_est = dict(chunk_est or {})
 
     def choices(self) -> List[OpBase]:
         return [
-            BlockChain("attn_blocks.chain", self._args, self._impl_choice),
+            BlockChain("attn_blocks.chain", self._args, self._impl_choice,
+                       self._chunk_counts, self._chunk_est),
             FusedBlockAttn("attn_blocks.fused", self._args),
             FusedBlockAttnBf16("attn_blocks.fused_bf16", self._args),
         ]
@@ -399,14 +512,22 @@ class BlockedAttention(CompoundOp):
     per-step kernel is a ChoiceOp when ``impl_choice``; with ``fused_choice``
     the whole chain additionally competes with the fused single-kernel flash
     (:class:`AttnEngineChoice`).  ``args.n_devices`` is reused as the block
-    count (no mesh involved)."""
+    count (no mesh involved).
+
+    ``chunk=True`` adds chunked sub-fold alternatives of each block's XLA
+    fold to the menus (core/chunking.py; :func:`fold_chunk_menu` prunes the
+    counts through the roofline — ``chunk_relax`` skips the pruning, the
+    CPU-smoke/tests mode)."""
 
     def __init__(self, args: RingAttnArgs, name: str = "blocked_attention",
-                 impl_choice: bool = False, fused_choice: bool = False):
+                 impl_choice: bool = False, fused_choice: bool = False,
+                 chunk: bool = False, chunk_relax: bool = False):
         super().__init__(name)
         self._args = args
         self._impl_choice = impl_choice
         self._fused_choice = fused_choice
+        self._chunk = chunk
+        self._chunk_relax = chunk_relax
 
     def args(self) -> RingAttnArgs:
         return self._args
@@ -414,14 +535,20 @@ class BlockedAttention(CompoundOp):
     def graph(self) -> Graph:
         g = Graph()
         n = self._args.n_devices
+        counts, est = ((), None)
+        if self._chunk:
+            counts, est = fold_chunk_menu(self._args,
+                                          relax=self._chunk_relax)
         fin = FinalizeAttn()
         if self._fused_choice:
-            eng = AttnEngineChoice(self._args, self._impl_choice)
+            eng = AttnEngineChoice(self._args, self._impl_choice,
+                                   counts, est)
             g.start_then(eng)
             g.then(eng, fin)
         else:
-            mk = BlockAttnChoice if self._impl_choice else BlockAttnStep
-            attns = [mk(f"attn_{s}", s, self._args) for s in range(n)]
+            attns = [_mk_block_step(f"attn_{s}", s, self._args,
+                                    self._impl_choice, counts, est)
+                     for s in range(n)]
             g.start_then(attns[0])
             for s in range(1, n):
                 g.then(attns[s - 1], attns[s])

@@ -26,7 +26,7 @@ Numerics are checked against the host evaluation of the unsharded layer stack
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -52,9 +52,10 @@ class TpLayerPartial(DeviceOp):
     """One layer's local half: gelu(x @ W1-column-block) @ W2-row-block —
     both matmuls on the MXU, producing this shard's partial output."""
 
-    def __init__(self, name: str, c: int, layer: int):
+    def __init__(self, name: str, c: int, layer: int, mb_rows: int = None):
         super().__init__(name)
         self._c, self._l = c, layer
+        self._mb = mb_rows  # per-chunk batch rows, for chunk_counts
 
     def _in(self) -> str:
         return f"X_{self._c}" if self._l == 0 else f"sum_{self._c}_{self._l - 1}"
@@ -75,6 +76,98 @@ class TpLayerPartial(DeviceOp):
         h = jax.nn.gelu(jnp.dot(x, w1, preferred_element_type=jnp.float32))
         part = jnp.dot(h.astype(x.dtype), w2, preferred_element_type=jnp.float32)
         return {f"part_{self._c}_{self._l}": part.astype(x.dtype)}
+
+    # -- op-chunking protocol (core/chunking.py, T3): the layer's two
+    # matmuls split over the batch rows into n partial GEMM pairs, each
+    # folding its row slice into the partial-output buffer — so the
+    # all-reduce post (the psum of this layer's output) can launch against
+    # the tail partials instead of waiting for the whole layer.
+    def chunkable(self) -> bool:
+        return True
+
+    def chunk_counts(self) -> List[int]:
+        # an op built without mb_rows is not chunkable — never guess
+        from tenzing_tpu.core.chunking import pow2_counts
+
+        return pow2_counts(self._mb)
+
+    def split(self, n: int) -> List["TpLayerRowsPartial"]:
+        rows = self._mb
+        if rows is None:
+            raise ValueError(
+                f"{self.name()}: split() needs the mb_rows extent")
+        if n < 1 or rows % n:
+            raise ValueError(f"{rows} batch rows do not split {n} ways")
+        return [TpLayerRowsPartial(f"{self.name()}.c{n}p{j}", self._c,
+                                   self._l, j, n, mb_rows=rows)
+                for j in range(n)]
+
+
+class TpLayerRowsPartial(TpLayerPartial):
+    """Partial ``j`` of an ``n``-way batch-row split of
+    :class:`TpLayerPartial` (the name avoids overloading "partial", which
+    in TP already means the per-shard pre-psum output): both matmuls over
+    its row slice, folded into the partial-output buffer by an
+    accumulating slice update (read-modify-write — the combine is the
+    update chain, so the psum post or another chunk's compute interleaves
+    between the partials)."""
+
+    def __init__(self, name: str, c: int, layer: int, part: int,
+                 n_parts: int, mb_rows: int = None):
+        super().__init__(name, c, layer, mb_rows=mb_rows)
+        self._part, self._n_parts = part, n_parts
+
+    def chunkable(self) -> bool:
+        return False  # a partial never re-splits
+
+    def reads(self):
+        return super().reads() + [f"part_{self._c}_{self._l}"]
+
+    def apply(self, bufs, ctx):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        x = bufs[self._in()]
+        w1 = bufs["W1"][self._l, :, :]
+        w2 = bufs["W2"][self._l, :, :]
+        rows = x.shape[0]
+        if rows % self._n_parts:
+            # chunk validity was checked against the build-time mb_rows;
+            # a sharded layout (dp) can hand this op fewer runtime rows —
+            # fail at trace time rather than slice 0/partial rows silently
+            raise ValueError(
+                f"{self.name()}: {rows} runtime rows do not split "
+                f"{self._n_parts} ways")
+        lo = self._part * (rows // self._n_parts)
+        xs = lax.dynamic_slice_in_dim(x, lo, rows // self._n_parts, 0)
+        h = jax.nn.gelu(jnp.dot(xs, w1, preferred_element_type=jnp.float32))
+        y = jnp.dot(h.astype(x.dtype), w2,
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+        return {f"part_{self._c}_{self._l}": lax.dynamic_update_slice_in_dim(
+            bufs[f"part_{self._c}_{self._l}"], y, lo, 0)}
+
+
+def mlp_chunk_menu(args: TpMlpArgs, relax: bool = False):
+    """(pruned counts, {count: est hidden µs}) for one chunk-layer's local
+    MLP half — the roofline sketch constraint
+    (bench/roofline.py::prune_chunkings).  The neighboring transfer is the
+    layer's all-reduce (a ring psum moves ~2x the partial-output bytes);
+    ``relax=True`` (tests / toy shapes) keeps every structurally valid
+    count."""
+    from tenzing_tpu.bench import roofline
+
+    bpe = np.dtype(args.dtype).itemsize
+    b, d = args.mb_size, args.d_model
+    dffl = args.d_ff // args.n_tp  # this shard's hidden columns
+    part = float(b * d * bpe)  # the partial-output rows (the psum payload)
+    cost = roofline.Cost(
+        flops=4.0 * b * d * dffl,
+        hbm_bytes=2.0 * part + float(2 * d * dffl * bpe))
+    return roofline.chunk_menu(
+        TpLayerPartial("probe", 0, 0, mb_rows=args.mb_size).chunk_counts(),
+        cost, comm_us=2.0 * part / (roofline.V5E_XFER_GBS * 1e9) * 1e6,
+        combine_bytes=2.0 * part, relax=relax)
 
 
 class ConcatOut(DeviceOp):
@@ -106,11 +199,20 @@ class ConcatOut(DeviceOp):
 class TpMlp(CompoundOp):
     """The whole TP forward as one compound: ``n_chunks`` independent
     layer chains (partial -> psum-post -> await per layer), joined by the
-    final concat."""
+    final concat.
 
-    def __init__(self, args: TpMlpArgs, name: str = "tp_mlp"):
+    ``chunk=True`` wraps each layer's local MLP half in a
+    :class:`~tenzing_tpu.core.chunking.ChunkChoice` so the solvers search
+    T3-style batch-row splits whose tail partials the psum post overlaps
+    (core/chunking.py; :func:`mlp_chunk_menu` prunes the counts through
+    the roofline — ``chunk_relax`` skips the pruning, the tests mode)."""
+
+    def __init__(self, args: TpMlpArgs, name: str = "tp_mlp",
+                 chunk: bool = False, chunk_relax: bool = False):
         super().__init__(name)
         self._args = args
+        self._chunk = chunk
+        self._chunk_relax = chunk_relax
 
     def args(self) -> TpMlpArgs:
         return self._args
@@ -118,11 +220,27 @@ class TpMlp(CompoundOp):
     def graph(self) -> Graph:
         a = self._args
         g = Graph()
+        counts, est = ((), None)
+        if self._chunk:
+            counts, est = mlp_chunk_menu(a, relax=self._chunk_relax)
+
+        def mk(cc, ll):
+            step = TpLayerPartial(f"mlp_{cc}_{ll}", cc, ll,
+                                  mb_rows=a.mb_size)
+            if any(int(n) > 1 for n in counts):
+                from tenzing_tpu.core.chunking import (
+                    ChunkChoice,
+                    chunk_variants,
+                )
+
+                return ChunkChoice(step, chunk_variants(step, counts, est))
+            return step
+
         cat = ConcatOut("tp_concat", a)
         for c in range(a.n_chunks):
             prev = None
             for l in range(a.n_layers):
-                mlp = TpLayerPartial(f"mlp_{c}_{l}", c, l)
+                mlp = mk(c, l)
                 post = PsumStart(
                     f"psum_{c}_{l}", f"part_{c}_{l}", f"sum_{c}_{l}", AXIS
                 )

@@ -212,6 +212,7 @@ def bench_section(paths: List[str]) -> List[str]:
              "overlap eff | dispatch ovh (us) |",
              "|---|---|---|---|---|---|---|---|---|---|---|"]
     fused_lines: List[str] = []
+    chunk_lines: List[str] = []
     for path in paths:
         try:
             d = load_driver_json(path)
@@ -236,6 +237,32 @@ def bench_section(paths: List[str]) -> List[str]:
                 ok=("—" if ver is None else ver),
                 oe=at.get("overlap_efficiency", "—"),
                 do=at.get("dispatch_overhead_us", "—")))
+        ch = perf.get("chunked")
+        if ch:
+            # op-chunking economics (docs/performance.md, "Chunked
+            # overlap"): what the roofline let onto the menus, what the
+            # search visited/chose, and the hidden comm the chunking
+            # bought — estimated bound vs stepped-timeline measurement
+            if "error" in ch and "menus" not in ch:
+                chunk_lines.append(
+                    f"- `{os.path.basename(path)}`: chunk provenance "
+                    f"failed ({ch['error']})")
+            else:
+                menus = ch.get("menus") or {}
+                n_gt1 = sum(1 for m in menus.values()
+                            if [c for c in m.get("counts", []) if c > 1])
+                chosen = ch.get("chosen") or {}
+                hc = ch.get("hidden_comm_us") or {}
+                msd = hc.get("measured")
+                chunk_lines.append(
+                    f"- `{os.path.basename(path)}`: {len(menus)} menu(s) "
+                    f"({n_gt1} with counts>1), searched counts "
+                    f"{ch.get('searched_counts', [])} over "
+                    f"{ch.get('n_candidates_chunked', 0)} candidate(s), "
+                    f"winner {'unchunked' if not chosen else chosen}, "
+                    f"hidden comm est {hc.get('estimated', 0)}us / "
+                    f"measured {'—' if msd is None else f'{msd}us'}"
+                    + (f" — {ch['note']}" if ch.get("note") else ""))
         fu = perf.get("fused")
         if fu:
             # megakernel-fusion economics (docs/performance.md): regions
@@ -261,6 +288,8 @@ def bench_section(paths: List[str]) -> List[str]:
     lines.append("")
     if fused_lines:
         lines += ["### Megakernel fusion", ""] + fused_lines + [""]
+    if chunk_lines:
+        lines += ["### Chunked overlap", ""] + chunk_lines + [""]
     return lines
 
 

@@ -680,6 +680,13 @@ class FusedExecutor:
         return self.plan(order).fused_order
 
     # -- ScheduleRunner protocol --------------------------------------------
+    def precompile(self, order: Sequence) -> bool:
+        """AOT-compile the FUSED program for ``order`` (the prefetch
+        pipeline's background-worker entry): lowering first keeps the
+        cache key the fused sequence's JSON, so the foreground
+        ``prepare_n`` of the same schedule hits."""
+        return self.inner.precompile(self.fused_order(order))
+
     def prepare(self, order: Sequence):
         return self.inner.prepare(self.fused_order(order))
 

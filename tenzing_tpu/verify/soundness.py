@@ -215,13 +215,25 @@ def happens_before_masks(ops: List[OpBase],
 def _resolved_choice(choice: ChoiceOp, names: frozenset) -> Optional[OpBase]:
     """The alternative of ``choice`` whose (possibly nested) ops were
     executed, found by name — the same name-anchored resolution the serdes
-    layer uses, reimplemented over public surfaces only."""
+    layer uses, reimplemented over public surfaces only.
+
+    The descent into a compound alternative skips its start/finish
+    sentinels: every sub-graph carries the same ``start``/``finish`` NoOp
+    names and every executed schedule contains them, so counting them as
+    mentions would make EVERY compound alternative match and resolve each
+    such choice to its first compound alternative regardless of what
+    actually executed (observed as chunked-count misprojection: a
+    ``.chunked.c4`` schedule projected as the ``.c2`` expansion, a false
+    ``missing_op``)."""
 
     def mentions(op: OpBase) -> bool:
         if op.name() in names:
             return True
         if isinstance(op, CompoundOp):
-            return any(mentions(v) for v in op.graph().vertices())
+            sub = op.graph()
+            sentinels = (id(sub.start()), id(sub.finish()))
+            return any(mentions(v) for v in sub.vertices()
+                       if id(v) not in sentinels)
         if isinstance(op, ChoiceOp):
             return any(mentions(c) for c in op.choices())
         return False
