@@ -35,6 +35,7 @@ from tenzing_tpu.fault.errors import (
     FaultClass,
     MeasurementTimeout,
     QuarantinedScheduleError,
+    StoreLockTimeout,
     TransientError,
     UnsoundScheduleError,
     classify_error,
@@ -67,6 +68,7 @@ __all__ = [
     "QuarantinedScheduleError",
     "ResilientBenchmarker",
     "SearchCheckpoint",
+    "StoreLockTimeout",
     "TransientError",
     "UnsoundScheduleError",
     "atomic_write_json",

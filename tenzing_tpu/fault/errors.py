@@ -56,6 +56,14 @@ class MeasurementTimeout(TransientError):
     would have blocked forever in a barrier instead reports a fault code."""
 
 
+class StoreLockTimeout(TransientError):
+    """The serving store's manifest lock stayed contended past the bounded
+    backoff (serve/segments.py: every manifest read-modify-write takes a
+    non-blocking flock through fault/backoff.py).  Transient by nature —
+    the rival writer will finish; retrying the whole operation later is
+    correct, waiting forever inside a serving request is not."""
+
+
 class DeterministicScheduleError(RuntimeError):
     """The schedule itself is broken (compile/shape/liveness); quarantine."""
 

@@ -439,8 +439,11 @@ def metrics_section(paths: List[str], top: int = 12) -> List[str]:
 def store_section(store_paths: List[str],
                   queue_dir: Optional[str] = None) -> List[str]:
     """The schedule-serving store as a report section (docs/serving.md):
-    what the fleet can answer without a search, and what is queued."""
-    from tenzing_tpu.serve.store import ScheduleStore
+    what the fleet can answer without a search, and what is queued.
+    Handles both backends via ``open_store`` — segmented directories gain
+    a per-bucket segment table, the compaction ledger, the admission
+    tally, and any serve-loop status documents found in the store."""
+    from tenzing_tpu.serve.store import open_store
 
     lines = ["## Schedule-serving stores", ""]
     for path in store_paths:
@@ -451,8 +454,8 @@ def store_section(store_paths: List[str],
             # the serving process to quarantine — a diagnostics command
             # must never rename the store it was asked to describe
             notes: List[str] = []
-            store = ScheduleStore(path, log=notes.append,
-                                  quarantine_corrupt=False)
+            store = open_store(path, log=notes.append,
+                               quarantine_corrupt=False)
             if notes and len(store) == 0:
                 lines += [f"### `{path}`", "", notes[0], ""]
                 continue
@@ -479,6 +482,10 @@ def store_section(store_paths: List[str],
                   f"{st['fingerprints']} fingerprint(s); "
                   f"{st['flagged']} flagged; "
                   f"{st['skipped_on_load']} skipped on load", ""]
+        if st.get("backend") == "segmented":
+            lines += segment_lines(st)
+        if os.path.isdir(path):
+            lines += serve_status_lines(path)
     if queue_dir is not None:
         if not os.path.isdir(queue_dir):
             # surface the operator error (a typo'd path) instead of
@@ -487,6 +494,81 @@ def store_section(store_paths: List[str],
                       "missing directory", ""]
             return lines
         lines += queue_section(queue_dir)
+    return lines
+
+
+def segment_lines(st: Dict[str, Any]) -> List[str]:
+    """The segmented-store internals (serve/segments.py stats): what the
+    compactor sees — per-bucket segment counts, live/orphan/damage
+    tallies, the admission verdicts, and the compaction ledger tail."""
+    seg = st.get("segments", {})
+    lines = ["#### segments", "",
+             "| bucket | segments | live | records | bytes |",
+             "|---|---|---|---|---|"]
+    for bucket, b in sorted(st.get("by_bucket", {}).items()):
+        lines.append(f"| `{bucket[:12]}` | {b.get('segments', 0)} | "
+                     f"{b.get('live', 0)} | {b.get('records', 0)} | "
+                     f"{b.get('bytes', 0)} |")
+    lines += ["",
+              f"- segments: {seg.get('count', 0)} "
+              f"({seg.get('bytes', 0)} bytes); "
+              f"orphans {seg.get('orphans', 0)}, "
+              f"missing {seg.get('missing', 0)}, "
+              f"quarantined {seg.get('quarantined', 0)}, "
+              f"newer-skipped {seg.get('newer_skipped', 0)}; "
+              f"checksum-failed records {st.get('checksum_failed', 0)}, "
+              f"salvaged {st.get('salvaged', 0)}"]
+    adm = st.get("admission", {})
+    lines.append(
+        f"- admission: {adm.get('verified', 0)} verified / "
+        f"{adm.get('unsound', 0)} unsound (never served) / "
+        f"{adm.get('unstamped', 0)} unstamped (lazy-verified)")
+    last = st.get("last_compaction")
+    if last:
+        lines.append(
+            f"- compactions: {st.get('compactions', 0)} ledgered; last: "
+            f"bucket `{str(last.get('bucket', '?'))[:12]}` "
+            f"{len(last.get('inputs', []))} -> 1 "
+            f"({last.get('records', 0)} record(s)) by "
+            f"{last.get('owner', '?')}")
+    else:
+        lines.append("- compactions: none ledgered")
+    lines.append("")
+    return lines
+
+
+def serve_status_lines(store_dir: str) -> List[str]:
+    """Serve-loop status documents (serve/listen.py ``status-*.json``)
+    found in a segmented store directory: liveness staleness + the
+    served/shed/timeout economics — the same probe-target treatment the
+    queue section gives daemon status docs."""
+    import time as _time
+
+    lines: List[str] = []
+    now = _time.time()
+    for name in sorted(os.listdir(store_dir)):
+        if not (name.startswith("status-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(store_dir, name)) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            lines.append(f"- service `{name}`: unreadable")
+            continue
+        if st.get("kind") != "serve_loop":
+            continue
+        c = st.get("counters", {})
+        stale = now - float(st.get("heartbeat_at", 0))
+        lines.append(
+            f"- service `{st.get('owner', name)}`: {st.get('state')}, "
+            f"heartbeat {stale:.1f}s ago — requests "
+            f"{c.get('requests', 0)} (exact {c.get('served_exact', 0)}, "
+            f"near {c.get('served_near', 0)}, cold "
+            f"{c.get('served_cold', 0)}), shed {c.get('shed', 0)}, "
+            f"timeouts {c.get('timeouts', 0)}, queue depth "
+            f"{st.get('queue_depth', 0)}")
+    if lines:
+        lines.append("")
     return lines
 
 

@@ -14,6 +14,18 @@ same machine-readable discipline as the bench driver):
   ``python -m tenzing_tpu.serve merge --store S --from OTHER.json``
 * ``stats`` — store/queue occupancy:
   ``python -m tenzing_tpu.serve stats --store S --queue QDIR``
+* ``listen`` — the long-lived service loop (serve/listen.py): batched
+  JSONL queries over stdin or a unix socket, bounded queue with explicit
+  load-shedding, per-request watchdog, graceful SIGTERM drain,
+  ``status-<owner>.json`` heartbeat.  ``python -m tenzing_tpu.serve
+  --listen ...`` is accepted as a spelling of the same mode.
+* ``compact`` — one offline compaction pass over a **segmented** store
+  directory (serve/segments.py): merge multi-segment buckets, adopt
+  orphans, reclaim — crash-consistent, lease-exclusive.
+
+``--store`` accepts both backends: a ``*.json`` path is the legacy
+monolithic store, anything else a segmented store directory
+(serve/store.py ``open_store``).
 
 Shape flags (``--halo-n`` / ``--m`` / ``--spmv-bw`` / ``--moe-tokens`` /
 ``--lanes`` / ``--smoke``) mirror the bench CLI: a query is exactly a
@@ -53,6 +65,11 @@ def _emit(doc) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--listen" in argv:
+        # the ISSUE/docs spelling `python -m tenzing_tpu.serve --listen`
+        # is the listen subcommand
+        argv = ["listen"] + [a for a in argv if a != "--listen"]
     ap = argparse.ArgumentParser(
         prog="python -m tenzing_tpu.serve",
         description="Schedule-serving store/resolver CLI (docs/serving.md)")
@@ -112,7 +129,67 @@ def main(argv: Optional[List[str]] = None) -> int:
     ps = sub.add_parser("stats", help="store/queue occupancy")
     common(ps)
 
+    pl = sub.add_parser("listen",
+                        help="long-lived service loop (docs/serving.md "
+                             "'Listen mode')")
+    common(pl)
+    pl.add_argument("--socket", default=None, metavar="PATH",
+                    help="serve a unix domain socket instead of "
+                         "stdin/stdout JSONL")
+    pl.add_argument("--max-pending", type=int, default=64,
+                    help="bounded request queue; beyond this, shed with "
+                         "retry_after")
+    pl.add_argument("--workers", type=int, default=2,
+                    help="resolution worker threads")
+    pl.add_argument("--request-timeout", type=float, default=10.0,
+                    metavar="SECS",
+                    help="per-request watchdog (0 disables)")
+    pl.add_argument("--shed-retry-after", type=float, default=0.5,
+                    metavar="SECS",
+                    help="retry_after hint carried by shed responses")
+    pl.add_argument("--heartbeat", type=float, default=2.0, metavar="SECS",
+                    help="status-document rewrite interval")
+    pl.add_argument("--idle-exit", type=float, default=None, metavar="SECS",
+                    help="socket mode: exit after this much silence (CI)")
+    pl.add_argument("--owner", default=None,
+                    help="worker id for the status doc (default host-pid)")
+    pl.add_argument("--status", default=None, metavar="PATH",
+                    help="status JSON path (default "
+                         "status-<owner>.json next to the store)")
+    pl.add_argument("--no-verify", action="store_true",
+                    help="skip lazy re-verification of unstamped records")
+    pl.add_argument("--near-max-sigma", type=float, default=0.75,
+                    help="near-miss uncertainty gate")
+
+    pc = sub.add_parser("compact",
+                        help="one offline compaction pass over a "
+                             "segmented store directory")
+    pc.add_argument("--store", required=True,
+                    help="segmented store directory (serve/segments.py)")
+    pc.add_argument("--owner", default=None,
+                    help="compactor id for the lease (default host-pid)")
+    pc.add_argument("--min-segments", type=int, default=2,
+                    help="segments per bucket before a merge-rewrite")
+    pc.add_argument("--lease-ttl", type=float, default=60.0, metavar="SECS",
+                    help="compaction lease TTL (expired leases reclaim)")
+    pc.add_argument("--grace", type=float, default=60.0, metavar="SECS",
+                    help="age before stale temp droppings are collected")
+    # chaos hook for the crash-consistency tests/CI: SIGKILL this process
+    # at a chosen publish boundary — not for operators
+    pc.add_argument("--crash-after", choices=("segment", "manifest"),
+                    default=None, help=argparse.SUPPRESS)
+
     args = ap.parse_args(argv)
+    if args.cmd == "compact":
+        from tenzing_tpu.serve.segments import Compactor
+
+        _emit(Compactor(args.store, owner=args.owner or "",
+                        min_segments=args.min_segments,
+                        lease_ttl_secs=args.lease_ttl,
+                        grace_secs=args.grace,
+                        log=lambda m: sys.stderr.write(m + "\n"),
+                        crash_after=args.crash_after).run())
+        return 0
     svc = _service_of(args)
     if args.cmd == "warm":
         _emit(svc.warm(_request_of(args), args.csv,
@@ -125,6 +202,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         _emit({"merged": out, "records": len(svc.store)})
     elif args.cmd == "stats":
         _emit(svc.stats())
+    elif args.cmd == "listen":
+        from tenzing_tpu.serve.listen import ListenOpts, ServeLoop
+
+        opts = ListenOpts(
+            max_pending=args.max_pending, workers=args.workers,
+            request_timeout_secs=args.request_timeout or 0.0,
+            shed_retry_after_secs=args.shed_retry_after,
+            heartbeat_secs=args.heartbeat,
+            idle_exit_secs=args.idle_exit, owner=args.owner or "",
+            status_path=args.status, socket_path=args.socket)
+        loop = ServeLoop(svc, opts,
+                         log=lambda m: sys.stderr.write(m + "\n"))
+        if args.socket:
+            _emit(loop.serve_socket(args.socket))
+        else:
+            _emit(loop.serve_stdin())
     return 0
 
 

@@ -12,8 +12,9 @@ built to survive the failure modes a long-lived multi-worker service
 actually meets — crashes, hangs, rival workers, and malformed requests —
 with the PR-3 fault machinery applied at the queue granularity:
 
-* **Leased claims** — a worker claims ``work-<exact>.json`` by
-  atomically publishing ``lease-<exact>.json`` (payload written to a
+* **Leased claims** (serve/lease.py — THE shared lease protocol, also
+  guarding the segment compactor) — a worker claims ``work-<exact>.json``
+  by atomically publishing ``lease-<exact>.json`` (payload written to a
   private temp file, then hard-linked into place: exactly one of any
   number of rivals succeeds, the rest see ``FileExistsError`` and move
   on).  A heartbeat thread renews the lease's **mtime**; a lease whose
@@ -96,6 +97,7 @@ from tenzing_tpu.fault.errors import (
 )
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.obs.tracer import get_tracer
+from tenzing_tpu.serve.lease import LeaseFile
 from tenzing_tpu.serve.store import WorkQueue
 from tenzing_tpu.utils.atomic import atomic_dump_json
 
@@ -290,7 +292,7 @@ class DrainDaemon:
         self.started_at = time.time()
         self._stop = threading.Event()
         self._lease_lost = threading.Event()
-        self._lease_nonce: Optional[str] = None
+        self._lease: Optional[LeaseFile] = None
         self._child: Optional[subprocess.Popen] = None
         self._depth = 0
         self._prev_handlers: Dict[int, Any] = {}
@@ -301,144 +303,51 @@ class DrainDaemon:
         else:
             sys.stderr.write(f"daemon[{self.owner}]: {msg}\n")
 
-    # -- lease protocol -----------------------------------------------------
+    # -- lease protocol (serve/lease.py — THE shared implementation) ---------
     def _claim(self, exact: str) -> Optional[str]:
         """Claim ``exact``'s item; None when a rival holds a fresh lease
-        or wins either race (see module docstring for the protocol)."""
-        lease = self.queue.lease_path_for(exact)
-        now = time.time()
-        try:
-            age = now - os.path.getmtime(lease)
-        except OSError:
-            age = None  # no lease: go straight to the fresh claim
-        if age is not None:
-            if age <= self.opts.lease_ttl_secs:
-                return None  # live rival
-            # expired: reclaim by atomic rename — one winner among any
-            # number of contenders (the losers' rename gets ENOENT)
-            stale = (f"{lease}.stale-{self.owner}-{os.getpid()}-"
-                     f"{int(now * 1e6)}")
-            try:
-                os.rename(lease, stale)
-            except OSError:
-                return None  # lost the reclaim race
-            prev_owner = "?"
-            try:
-                with open(stale) as f:
-                    prev_owner = json.load(f).get("owner", "?")
-            except (OSError, ValueError):
-                pass
-            try:
-                os.unlink(stale)
-            except OSError:
-                pass
+        or wins either race (serve/lease.py for the protocol)."""
+        lease = LeaseFile(self.queue.lease_path_for(exact), self.owner,
+                          ttl_secs=self.opts.lease_ttl_secs)
+        info = lease.claim(extra={"exact": exact})
+        if info is None:
+            return None
+        if info.reclaimed:
             self.counters["reclaimed"] += 1
             get_metrics().counter("daemon.reclaimed").inc()
             tr = get_tracer()
             if tr.enabled:
                 tr.event("daemon.reclaim", exact=exact,
-                         prev_owner=prev_owner, age_s=round(age, 3))
+                         prev_owner=info.prev_owner, age_s=info.age_s)
             self._log(f"reclaimed expired lease for {exact[:12]} "
-                      f"(owner {prev_owner}, {age:.1f}s stale)")
-        # fresh claim: publish-by-hard-link — the payload is fully
-        # written and fsynced in a private temp file before the link, so
-        # a rival never reads a torn lease, and the link itself is the
-        # atomic winner-takes-all step.  The nonce is the lease's
-        # identity: inode numbers get recycled the moment a file is
-        # unlinked, so "same path, same inode" does NOT mean "still our
-        # claim" — the renewal re-reads the nonce instead.
-        nonce = (f"{self.owner}-{os.getpid()}-{threading.get_ident()}-"
-                 f"{int(now * 1e6)}")
-        payload = {"owner": self.owner, "pid": os.getpid(),
-                   "host": socket.gethostname(), "exact": exact,
-                   "claimed_at": now, "ttl_s": self.opts.lease_ttl_secs,
-                   "nonce": nonce}
-        os.makedirs(self.queue.dir, exist_ok=True)
-        # thread id in the temp name: two same-owner daemons embedded in
-        # one process must not interleave writes to one temp file
-        tmp = f"{lease}.{self.owner}.{os.getpid()}.{threading.get_ident()}.tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(payload, f, sort_keys=True)
-                f.flush()
-                os.fsync(f.fileno())
-            try:
-                os.link(tmp, lease)
-            except OSError:
-                return None  # a rival landed first
-            self._lease_nonce = nonce
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+                      f"(owner {info.prev_owner}, {info.age_s:.1f}s stale)")
+        self._lease = lease
         self._lease_lost.clear()
         self.counters["claimed"] += 1
         get_metrics().counter("daemon.claimed").inc()
-        return lease
-
-    def _owns(self, lease: str) -> bool:
-        if self._lease_nonce is None:
-            return False  # nothing claimed; never matches a nonce-less file
-        try:
-            with open(lease) as f:
-                return json.load(f).get("nonce") == self._lease_nonce
-        except (OSError, ValueError):
-            return False
+        return lease.path
 
     def _renew(self, lease: str) -> bool:
-        """Heartbeat: bump the lease mtime — but only while it is still
-        OUR lease (the claim nonce in the payload; inode numbers recycle
-        on unlink so they cannot identify a claim).  A mismatch means a
-        rival reclaimed it during a stall; flag it so the drain aborts
-        instead of double-running."""
-        if not self._owns(lease):
+        """Heartbeat: renew the claim's mtime (serve/lease.py — nonce
+        re-read, never an inode check).  A failed renew means a rival
+        reclaimed it during a stall; flag it so the drain aborts instead
+        of double-running."""
+        lf = self._lease
+        if lf is None or lf.path != lease or not lf.renew():
             self._lease_lost.set()
             return False
-        try:
-            os.utime(lease, None)
-            return True
-        except OSError:
-            self._lease_lost.set()
-            return False
+        return True
 
     def _release(self, lease: str) -> None:
-        """Delete the lease iff it is still ours — atomically.  A bare
-        check-then-unlink has a stall window (``_owns`` true, we pause
-        past the TTL, a rival reclaims and publishes, our unlink deletes
-        the rival's LIVE lease): instead the lease is *grabbed* by rename
-        (one winner), inspected privately, and either deleted (ours) or
-        re-published by hard link (a rival's — put it back).  If a third
-        party claims during the grab window the re-link loses and the
-        rival's own heartbeat detects the loss (nonce mismatch) and
-        aborts — the designed recovery, never a silent double-run."""
-        if self._lease_nonce is None:
+        """Release the claim iff still ours — the grab-inspect-release
+        discipline lives in :meth:`LeaseFile.release`; a rival's live
+        lease is restored, never deleted."""
+        lf = self._lease
+        if lf is None or lf.path != lease:
             return
-        grab = (f"{lease}.release.{self.owner}.{os.getpid()}."
-                f"{threading.get_ident()}")
-        try:
-            os.rename(lease, grab)
-        except OSError:
-            self._lease_nonce = None
-            return  # already gone (reclaimed + released by a rival)
-        ours = False
-        try:
-            with open(grab) as f:
-                ours = json.load(f).get("nonce") == self._lease_nonce
-        except (OSError, ValueError):
-            pass
-        if ours:
+        if lf.release():
             self.counters["released"] += 1
-        else:
-            try:
-                os.link(grab, lease)  # a rival's live claim: restore it
-            except OSError:
-                pass
-        try:
-            os.unlink(grab)
-        except OSError:
-            pass
-        self._lease_nonce = None
+        self._lease = None
 
     # -- status / liveness ---------------------------------------------------
     def _write_status(self, state: str,

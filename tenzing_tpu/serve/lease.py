@@ -1,0 +1,196 @@
+"""THE lease-file protocol: claim-by-hardlink, mtime-heartbeat,
+reclaim-by-rename, grab-inspect-release.
+
+One mutual-exclusion discipline for every long-running exclusive job in
+the serving tree — extracted from serve/daemon.py (where it was born, PR
+9, guarding per-item queue claims) when the segment compactor
+(serve/segments.py) needed the identical protocol for its store-wide
+compaction lease.  The invariants, each carried over verbatim:
+
+* **Claim** — the owner payload (owner id, pid, host, claim time, a
+  unique **nonce**) is fully written and fsynced to a private temp file,
+  then *hard-linked* to the lease path: exactly one of any number of
+  rivals wins the link (``FileExistsError`` for the rest), and a rival
+  can never read a torn lease.
+* **Heartbeat** — renewing bumps the lease file's **mtime**; a lease
+  whose mtime is older than the TTL is *expired*.  Renewal re-reads the
+  payload nonce first: inode numbers recycle the moment a file is
+  unlinked, so "same path, same inode" does NOT mean "still our claim".
+  A holder that lost its lease during a stall learns it from the failed
+  renew and must abort its work instead of double-running.
+* **Reclaim** — an expired lease is reclaimed by atomic rename (again:
+  one winner among any number of contenders; the losers' rename gets
+  ``ENOENT``), so a SIGKILLed holder's claim is never lost forever.
+* **Release** — delete iff still ours, *atomically*.  A bare
+  check-then-unlink has a stall window (``owns`` true, we pause past the
+  TTL, a rival reclaims and publishes, our unlink deletes the rival's
+  LIVE lease): instead the lease is *grabbed* by rename (one winner),
+  inspected privately, and either deleted (ours) or re-published by hard
+  link (a rival's — put it back).  If a third party claims during the
+  grab window the re-link loses and the rival's own heartbeat detects
+  the loss (nonce mismatch) and aborts — the designed recovery, never a
+  silent double-run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class ClaimInfo:
+    """What :meth:`LeaseFile.claim` reports on success: whether the claim
+    reclaimed an expired rival first (the caller's counter/telemetry
+    decision, not the protocol's), and whose."""
+
+    reclaimed: bool = False
+    prev_owner: Optional[str] = None
+    age_s: Optional[float] = None
+
+
+class LeaseFile:
+    """One lease path's view of the protocol (module docstring).  The
+    object is single-claim: ``claim()`` then ``renew()``/``owns()`` until
+    ``release()``; a fresh claim needs a fresh nonce but may reuse the
+    object."""
+
+    def __init__(self, path: str, owner: str,
+                 ttl_secs: float = 60.0,
+                 log: Optional[Callable[[str], None]] = None):
+        self.path = path
+        self.owner = owner
+        self.ttl_secs = float(ttl_secs)
+        self.nonce: Optional[str] = None
+        self._log = log
+
+    def _note(self, msg: str) -> None:
+        if self._log is not None:
+            self._log(msg)
+
+    # -- claim ---------------------------------------------------------------
+    def claim(self, extra: Optional[Dict[str, Any]] = None
+              ) -> Optional[ClaimInfo]:
+        """Claim the lease; ``None`` when a rival holds a fresh lease or
+        wins either race.  ``extra`` keys ride in the payload (the daemon
+        stamps the claimed item's exact digest)."""
+        now = time.time()
+        info = ClaimInfo()
+        try:
+            age = now - os.path.getmtime(self.path)
+        except OSError:
+            age = None  # no lease: go straight to the fresh claim
+        if age is not None:
+            if age <= self.ttl_secs:
+                return None  # live rival
+            # expired: reclaim by atomic rename — one winner among any
+            # number of contenders (the losers' rename gets ENOENT)
+            stale = (f"{self.path}.stale-{self.owner}-{os.getpid()}-"
+                     f"{int(now * 1e6)}")
+            try:
+                os.rename(self.path, stale)
+            except OSError:
+                return None  # lost the reclaim race
+            prev_owner = "?"
+            try:
+                with open(stale) as f:
+                    prev_owner = json.load(f).get("owner", "?")
+            except (OSError, ValueError):
+                pass
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+            info = ClaimInfo(reclaimed=True, prev_owner=prev_owner,
+                             age_s=round(age, 3))
+        # fresh claim: publish-by-hard-link — the payload is fully
+        # written and fsynced in a private temp file before the link, so
+        # a rival never reads a torn lease, and the link itself is the
+        # atomic winner-takes-all step
+        nonce = (f"{self.owner}-{os.getpid()}-{threading.get_ident()}-"
+                 f"{int(now * 1e6)}")
+        payload = {"owner": self.owner, "pid": os.getpid(),
+                   "host": socket.gethostname(),
+                   "claimed_at": now, "ttl_s": self.ttl_secs,
+                   "nonce": nonce, **(extra or {})}
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        # thread id in the temp name: two same-owner holders embedded in
+        # one process must not interleave writes to one temp file
+        tmp = (f"{self.path}.{self.owner}.{os.getpid()}."
+               f"{threading.get_ident()}.tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                os.link(tmp, self.path)
+            except OSError:
+                return None  # a rival landed first
+            self.nonce = nonce
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return info
+
+    # -- heartbeat -----------------------------------------------------------
+    def owns(self) -> bool:
+        if self.nonce is None:
+            return False  # nothing claimed; never matches a nonce-less file
+        try:
+            with open(self.path) as f:
+                return json.load(f).get("nonce") == self.nonce
+        except (OSError, ValueError):
+            return False
+
+    def renew(self) -> bool:
+        """Bump the lease mtime — but only while it is still OUR lease
+        (nonce re-read; see module docstring).  False means a rival
+        reclaimed it: the holder must abort, not double-run."""
+        if not self.owns():
+            return False
+        try:
+            os.utime(self.path, None)
+            return True
+        except OSError:
+            return False
+
+    # -- release -------------------------------------------------------------
+    def release(self) -> bool:
+        """Grab-inspect-release (module docstring); returns True iff the
+        lease was ours and is now deleted.  Always clears the nonce —
+        after a release attempt this object holds nothing."""
+        if self.nonce is None:
+            return False
+        grab = (f"{self.path}.release.{self.owner}.{os.getpid()}."
+                f"{threading.get_ident()}")
+        try:
+            os.rename(self.path, grab)
+        except OSError:
+            self.nonce = None
+            return False  # already gone (reclaimed + released by a rival)
+        ours = False
+        try:
+            with open(grab) as f:
+                ours = json.load(f).get("nonce") == self.nonce
+        except (OSError, ValueError):
+            pass
+        if not ours:
+            try:
+                os.link(grab, self.path)  # a rival's live claim: restore it
+            except OSError:
+                pass
+        try:
+            os.unlink(grab)
+        except OSError:
+            pass
+        self.nonce = None
+        return ours

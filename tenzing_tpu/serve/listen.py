@@ -1,0 +1,541 @@
+"""The long-lived serving loop: ``python -m tenzing_tpu.serve listen``.
+
+Process-per-query was fine for smoke tests; a fleet front door is a
+process that stays up.  This module wraps one
+:class:`~tenzing_tpu.serve.service.ScheduleService` in a bounded,
+load-shedding request loop (docs/serving.md "Listen mode"):
+
+* **Transports** — newline-delimited JSON over **stdin/stdout** (the
+  default: trivially driveable from a shell, a pipe, or a supervisor) or
+  a **unix domain socket** (``--socket PATH``; any number of concurrent
+  connections, one reader thread each, responses interleaved per
+  connection under a write lock).  One request per line, one response
+  line per request, matched by the client-chosen ``id``.
+* **Protocol** — ``{"op": "query", "id": ..., "request": {DriverRequest
+  fields}}`` resolves one request; ``{"op": "batch", "requests": [...]}``
+  resolves many in one trip (one queue slot, one response line — the
+  batched API that amortizes transport overhead at fleet rates);
+  ``stats`` and ``ping`` round out liveness probing.
+* **Bounded queue + explicit shedding** — at most ``--max-pending``
+  requests wait; beyond that the loop answers **immediately** with
+  ``{"shed": true, "retry_after": <secs>}`` and counts ``serve.shed``
+  — a server that cannot keep up says so in microseconds instead of
+  letting every client time out in line (the same honesty rule as the
+  near tier's uncertainty gate: a non-answer now beats a bad answer
+  later).
+* **Per-request watchdog** — a request older than
+  ``--request-timeout`` is answered with a classified timeout
+  (``error_class: transient`` — the fault taxonomy of
+  fault/errors.py, the caller may retry) even while the worker that
+  picked it up is still grinding; the worker's late result is
+  discarded.  Store-lock contention inside resolution is already
+  bounded by the segmented store's backoff
+  (:class:`~tenzing_tpu.fault.errors.StoreLockTimeout`).
+* **Graceful drain** — SIGTERM/SIGINT stops intake, drains everything
+  already queued, stamps the status document ``stopped``, and exits; a
+  second signal abandons the drain.
+* **Status/heartbeat** — ``status-<owner>.json`` next to the store,
+  atomically rewritten every ``--heartbeat`` seconds with state, queue
+  depth, per-tier served counts, shed/timeout tallies — the same
+  liveness-probe contract as the drain daemon's status document, and
+  the report CLI renders both.
+
+Every response carries ``resolve_us`` (the resolution's own latency,
+excluding queue wait) so a replaying client can build the latency
+distribution the ROADMAP's pct99 metric tracks without trusting the
+server's aggregates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue
+import signal
+import socket as _socket
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from tenzing_tpu.fault.errors import classify_error
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs.tracer import get_tracer
+from tenzing_tpu.utils.atomic import atomic_dump_json
+
+STATUS_VERSION = 1
+
+
+@dataclass
+class ListenOpts:
+    """Knobs of one :class:`ServeLoop` (CLI flags map 1:1)."""
+
+    max_pending: int = 64            # bounded queue: beyond this, shed
+    workers: int = 2                 # resolution worker threads
+    request_timeout_secs: float = 10.0   # per-request watchdog
+    shed_retry_after_secs: float = 0.5   # the hint shed responses carry
+    heartbeat_secs: float = 2.0      # status rewrite interval
+    idle_exit_secs: Optional[float] = None  # exit after idling (CI)
+    owner: str = ""                  # default: <host>-<pid>
+    status_path: Optional[str] = None
+    socket_path: Optional[str] = None
+    handle_signals: bool = True
+
+
+class _Pending:
+    """One in-flight request: complete-once semantics — whoever gets
+    there first (worker result, watchdog timeout, shutdown shed) writes
+    the response; everyone else's attempt is a no-op."""
+
+    __slots__ = ("rid", "payload", "respond", "enqueued_at", "deadline",
+                 "_done", "_lock")
+
+    def __init__(self, rid, payload: Dict[str, Any],
+                 respond: Callable[[Dict[str, Any]], None],
+                 deadline: Optional[float]):
+        self.rid = rid
+        self.payload = payload
+        self.respond = respond
+        self.enqueued_at = time.time()
+        self.deadline = deadline
+        self._done = False
+        self._lock = threading.Lock()
+
+    def complete(self, doc: Dict[str, Any]) -> bool:
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+        out = dict(doc)
+        if self.rid is not None:
+            out["id"] = self.rid
+        try:
+            self.respond(out)
+        except Exception:
+            pass  # a vanished client must not take the loop down
+        return True
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+
+class ServeLoop:
+    """See module docstring.  Embeddable: tests drive :meth:`submit` /
+    :meth:`start` / :meth:`drain` directly; the CLI runs
+    :meth:`serve_stdin` or :meth:`serve_socket`."""
+
+    def __init__(self, service, opts: Optional[ListenOpts] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self.service = service
+        self.opts = opts or ListenOpts()
+        self.owner = self.opts.owner or \
+            f"{_socket.gethostname()}-{os.getpid()}"
+        self._log_fn = log
+        self.counters: Dict[str, int] = {
+            k: 0 for k in ("requests", "batches", "served_exact",
+                           "served_near", "served_cold", "shed",
+                           "timeouts", "errors", "malformed", "signals")}
+        # socket mode bumps counters from one reader thread per
+        # connection plus the workers and the watchdog — unlocked
+        # dict += would lose counts under interleaving, and these are
+        # the economics the status doc and the replay benchmark read
+        self._count_lock = threading.Lock()
+        self.started_at = time.time()
+        self._stop = threading.Event()       # stop intake, drain
+        self._abandon = threading.Event()    # second signal: stop now
+        self._queue: "_queue.Queue[_Pending]" = _queue.Queue(
+            maxsize=max(1, self.opts.max_pending))
+        self._live: "set[_Pending]" = set()
+        self._live_lock = threading.Lock()
+        # resolution is serialized: the resolver's caches and the store
+        # flag/enqueue writes are not thread-safe, and the hot path is a
+        # dict probe — worker concurrency buys queueing, not resolution
+        self._resolve_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._prev_handlers: Dict[int, Any] = {}
+        self.last_request_at = time.time()
+        store_path = getattr(self.service.store, "path", None)
+        base = (os.path.dirname(os.path.abspath(store_path))
+                if isinstance(store_path, str) and store_path.endswith(
+                    ".json")
+                else store_path if isinstance(store_path, str)
+                else ".")
+        self.status_path = self.opts.status_path or os.path.join(
+            base, f"status-{self.owner}.json")
+
+    def _log(self, msg: str) -> None:
+        if self._log_fn is not None:
+            self._log_fn(msg)
+        else:
+            sys.stderr.write(f"serve[{self.owner}]: {msg}\n")
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._count_lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    # -- status --------------------------------------------------------------
+    def _write_status(self, state: str) -> None:
+        doc = {
+            "version": STATUS_VERSION,
+            "kind": "serve_loop",
+            "owner": self.owner,
+            "pid": os.getpid(),
+            "host": _socket.gethostname(),
+            "started_at": self.started_at,
+            "heartbeat_at": time.time(),
+            "state": state,
+            "queue_depth": self._queue.qsize(),
+            "in_flight": len(self._live),
+            "counters": dict(self.counters),
+            "store": getattr(self.service.store, "path", None),
+            "socket": self.opts.socket_path,
+        }
+        try:
+            atomic_dump_json(self.status_path, doc, prefix=".status.")
+        except OSError as e:
+            self._log(f"status write failed ({e})")
+
+    # -- intake --------------------------------------------------------------
+    def submit(self, payload: Dict[str, Any],
+               respond: Callable[[Dict[str, Any]], None]) -> None:
+        """One parsed request line: enqueue, or shed immediately when
+        the bounded queue is full / the loop is draining."""
+        rid = payload.get("id") if isinstance(payload, dict) else None
+        self._bump("requests")
+        self.last_request_at = time.time()
+        if not isinstance(payload, dict) or \
+                payload.get("op", "query") not in ("query", "batch",
+                                                   "stats", "ping"):
+            self._bump("malformed")
+            _Pending(rid, {}, respond, None).complete({
+                "ok": False, "error": "malformed request "
+                "(op must be query|batch|stats|ping)",
+                "error_class": "deterministic"})
+            return
+        deadline = (time.time() + self.opts.request_timeout_secs
+                    if self.opts.request_timeout_secs else None)
+        pending = _Pending(rid, payload, respond, deadline)
+        if self._stop.is_set():
+            self._shed(pending, reason="draining")
+            return
+        # registered live BEFORE the enqueue: a worker that grabs the
+        # item instantly must find it registered, or the discard would
+        # lose to the add and leak a ghost into the watchdog's view
+        with self._live_lock:
+            self._live.add(pending)
+        try:
+            self._queue.put_nowait(pending)
+        except _queue.Full:
+            with self._live_lock:
+                self._live.discard(pending)
+            self._shed(pending, reason="queue-full")
+            return
+
+    def _shed(self, pending: _Pending, reason: str) -> None:
+        self._bump("shed")
+        get_metrics().counter("serve.shed").inc()
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event("serve.shed", reason=reason,
+                     depth=self._queue.qsize())
+        pending.complete({
+            "ok": False, "shed": True, "reason": reason,
+            "retry_after": self.opts.shed_retry_after_secs,
+            "error_class": "transient"})
+
+    # -- workers -------------------------------------------------------------
+    def _resolve_one(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        from tenzing_tpu.bench.driver import DriverRequest
+
+        with self._resolve_lock:
+            # timed inside the lock: resolve_us is the resolution's own
+            # latency (the serve.resolve_us series), not queue/lock wait
+            t0 = time.perf_counter()
+            res = self.service.query(DriverRequest(**(request or {})))
+            dt_us = (time.perf_counter() - t0) * 1e6
+        out = res.to_json()
+        out["resolve_us"] = round(dt_us, 1)
+        self._bump(f"served_{res.tier}")
+        return out
+
+    def _handle(self, pending: _Pending) -> Dict[str, Any]:
+        payload = pending.payload
+        op = payload.get("op", "query")
+        if op == "ping":
+            return {"ok": True, "pong": True, "owner": self.owner}
+        if op == "stats":
+            with self._resolve_lock:
+                return {"ok": True, "stats": self.service.stats()}
+        if op == "batch":
+            reqs = payload.get("requests") or []
+            self._bump("batches")
+            get_metrics().counter("serve.listen.batches").inc()
+            results = []
+            for r in reqs:
+                req = r.get("request", r) if isinstance(r, dict) else {}
+                try:
+                    results.append(self._resolve_one(req))
+                except Exception as e:
+                    results.append({"error": str(e)[:500],
+                                    "error_class": classify_error(e)})
+            return {"ok": True, "results": results}
+        return {"ok": True,
+                "result": self._resolve_one(payload.get("request") or {})}
+
+    def _worker(self) -> None:
+        while True:
+            try:
+                pending = self._queue.get(timeout=0.1)
+            except _queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                if pending.done:
+                    continue  # timed out while queued: already answered
+                try:
+                    doc = self._handle(pending)
+                except Exception as e:
+                    self._bump("errors")
+                    get_metrics().counter("serve.listen.errors").inc()
+                    doc = {"ok": False, "error": str(e)[:500],
+                           "error_class": classify_error(e)}
+                # a late result loses to the watchdog silently: the
+                # client already got its transient-classified timeout
+                pending.complete(doc)
+            finally:
+                with self._live_lock:
+                    self._live.discard(pending)
+                self._queue.task_done()
+
+    def _watchdog(self) -> None:
+        while not self._abandon.is_set():
+            now = time.time()
+            with self._live_lock:
+                overdue = [p for p in self._live
+                           if p.deadline is not None and now > p.deadline
+                           and not p.done]
+            for p in overdue:
+                if p.complete({
+                        "ok": False, "timed_out": True,
+                        "error": (f"request exceeded "
+                                  f"{self.opts.request_timeout_secs}s "
+                                  "watchdog"),
+                        "error_class": "transient",
+                        "retry_after": self.opts.shed_retry_after_secs}):
+                    self._bump("timeouts")
+                    get_metrics().counter("serve.listen.timeouts").inc()
+                with self._live_lock:
+                    self._live.discard(p)
+            # sleep on ABANDON, not stop: once stop is set (the whole
+            # drain window) a stop.wait would return instantly and this
+            # loop would spin a core while contending _live_lock
+            if self._abandon.wait(0.05):
+                return
+            if self._stop.is_set() and not self._live and \
+                    self._queue.empty():
+                return
+
+    def _heartbeat(self) -> None:
+        while not self._stop.wait(self.opts.heartbeat_secs):
+            self._write_status("serving")
+            get_metrics().gauge("serve.queue_depth").set(
+                float(self._queue.qsize()))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        for i in range(max(1, self.opts.workers)):
+            t = threading.Thread(target=self._worker,
+                                 name=f"serve-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        for fn, name in ((self._watchdog, "serve-watchdog"),
+                         (self._heartbeat, "serve-heartbeat")):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._write_status("serving")
+
+    def stop(self) -> None:
+        """Stop intake; workers drain what is queued (the programmatic
+        twin of SIGTERM)."""
+        self._stop.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for queued + in-flight work to finish; True when fully
+        drained."""
+        self._stop.set()
+        deadline = time.time() + timeout
+        while time.time() < deadline and not self._abandon.is_set():
+            with self._live_lock:
+                live = len(self._live)
+            if live == 0 and self._queue.empty():
+                break
+            time.sleep(0.02)
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.time()))
+        ok = self._queue.empty()
+        self._write_status("stopped")
+        return ok
+
+    def _on_signal(self, signum, frame) -> None:
+        self.counters["signals"] += 1
+        if self.counters["signals"] >= 2:
+            self._abandon.set()
+        self._stop.set()
+
+    def _install_signals(self) -> None:
+        if not self.opts.handle_signals:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+
+    def _restore_signals(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (OSError, ValueError):
+                pass
+        self._prev_handlers.clear()
+
+    def summary(self) -> Dict[str, Any]:
+        return {"owner": self.owner, "counters": dict(self.counters),
+                "status": self.status_path,
+                "wall_s": round(time.time() - self.started_at, 3)}
+
+    # -- transports ----------------------------------------------------------
+    def serve_stdin(self, stdin=None, stdout=None) -> Dict[str, Any]:
+        """JSONL over stdin/stdout until EOF or a signal; then drain."""
+        stdin = stdin if stdin is not None else sys.stdin
+        stdout = stdout if stdout is not None else sys.stdout
+        out_lock = threading.Lock()
+
+        def respond(doc: Dict[str, Any]) -> None:
+            with out_lock:
+                stdout.write(json.dumps(doc) + "\n")
+                stdout.flush()
+
+        self._install_signals()
+        self.start()
+        self._log(f"listening on stdin (status {self.status_path})")
+        try:
+            for line in stdin:
+                if self._stop.is_set():
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line)
+                except ValueError as e:
+                    self._bump("malformed")
+                    respond({"ok": False,
+                             "error": f"bad json: {str(e)[:200]}",
+                             "error_class": "deterministic"})
+                    continue
+                self.submit(payload, respond)
+        finally:
+            self.drain()
+            self._restore_signals()
+        return self.summary()
+
+    def serve_socket(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """JSONL over a unix domain socket until a signal (or
+        ``idle_exit_secs`` of silence); concurrent connections each get
+        a reader thread; responses serialize per connection."""
+        path = path or self.opts.socket_path
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(16)
+        srv.settimeout(0.25)
+        self._install_signals()
+        self.start()
+        self._log(f"listening on {path} (status {self.status_path})")
+        conn_threads: List[threading.Thread] = []
+
+        def client(conn: _socket.socket) -> None:
+            wlock = threading.Lock()
+
+            def respond(doc: Dict[str, Any]) -> None:
+                data = (json.dumps(doc) + "\n").encode()
+                with wlock:
+                    conn.sendall(data)
+
+            buf = b""
+            try:
+                conn.settimeout(0.25)
+                while not self._abandon.is_set():
+                    try:
+                        chunk = conn.recv(1 << 16)
+                    except _socket.timeout:
+                        if self._stop.is_set():
+                            break
+                        continue
+                    except OSError:
+                        break
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        try:
+                            payload = json.loads(line)
+                        except ValueError as e:
+                            self._bump("malformed")
+                            respond({"ok": False,
+                                     "error": f"bad json: {str(e)[:200]}",
+                                     "error_class": "deterministic"})
+                            continue
+                        self.submit(payload, respond)
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        try:
+            while not self._stop.is_set():
+                if (self.opts.idle_exit_secs is not None
+                        and not self._live and self._queue.empty()
+                        and time.time() - self.last_request_at
+                        >= self.opts.idle_exit_secs):
+                    self._log(f"idle for {self.opts.idle_exit_secs}s — "
+                              "exiting")
+                    break
+                try:
+                    conn, _ = srv.accept()
+                except _socket.timeout:
+                    continue
+                except OSError:
+                    break
+                # prune dead readers so days of short-lived connections
+                # don't accumulate one Thread object each
+                conn_threads[:] = [t for t in conn_threads if t.is_alive()]
+                t = threading.Thread(target=client, args=(conn,),
+                                     daemon=True)
+                t.start()
+                conn_threads.append(t)
+        finally:
+            try:
+                srv.close()
+            except OSError:
+                pass
+            self.drain()
+            for t in conn_threads:
+                t.join(timeout=1.0)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._restore_signals()
+        return self.summary()

@@ -1,0 +1,355 @@
+"""Trace-replay serving benchmark: ``python -m tenzing_tpu.serve.replay``.
+
+The ROADMAP's serving metric — "drive ``serve.resolve_us`` pct99 down
+100x under a replayed high-QPS trace" — needs a harness before it needs
+optimizations.  This module is that harness (ISSUE 11 satellite): a
+**seeded synthetic query trace** (shape/workload mix over the committed
+halo/spmv corpora) replayed against two resolution paths over
+identically-warmed stores:
+
+* **monolithic-legacy** — the pre-PR path, replayed exactly: the
+  monolithic JSON-document store, no exact-answer cache, admission
+  stamps ignored, every exact hit re-materialized and re-verified
+  (``Resolver(serve_cache=False, legacy_verify=True)``);
+* **segmented** — the post-PR path end to end: segmented store,
+  admission-time verification, the sealed in-memory exact cache, all
+  driven through the real :class:`~tenzing_tpu.serve.listen.ServeLoop`
+  at a paced target QPS, so shed/timeout behavior is measured, not
+  assumed.
+
+Both paths get one uncounted warmup pass per *distinct* request shape
+(graph/verifier caches hot on both sides — the comparison isolates the
+per-query serving work, not one-time graph construction).  Latencies are
+grouped **by resolved tier**; the headline number is the exact tier's
+pct99 ratio, the acceptance criterion the ISSUE pins (≥10x with zero
+per-query verifier invocations).  Results land as one JSON document
+(``SERVE_BENCH_r01.json`` committed at the repo root, alongside the
+``BENCH_*`` series) and one summary line on stdout.
+
+The trace is deterministic: ``random.Random(seed)`` draws workload and
+tier-class per query from the requested mix; "near" shapes sit in the
+warmed shape's bucket (power-of-two bucketing, serve/fingerprint.py),
+"cold" shapes in other buckets — so the trace exercises the cache, the
+near tier's surrogate pricing, and the cold tier's ensure-not-rewrite
+path in one stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.utils.numeric import percentile
+
+REPLAY_VERSION = 1
+
+# per-workload shape knob: (field, near value, cold values) — "exact"
+# queries use the warmed default shape; "near" sits in its power-of-two
+# bucket with a different exact digest (halo: n 500 vs 512 both bucket
+# 512; spmv: m 200000 vs 150000 both bucket 262144 with bw in bucket
+# 32768), "cold" in other buckets.  Golden-checked against
+# serve/fingerprint.py's shape_bucket boundaries.
+_SHAPE_KNOBS: Dict[str, Tuple[str, int, List[int]]] = {
+    "halo": ("halo_n", 500, [1024, 2048]),
+    "spmv": ("m", 200000, [100000, 60000]),
+}
+
+
+def _req_kwargs(workload: str, kind: str, i: int = 0) -> Dict[str, Any]:
+    field, near, colds = _SHAPE_KNOBS[workload]
+    if kind == "exact":
+        return {"workload": workload}
+    if kind == "near":
+        return {"workload": workload, field: near}
+    # a couple of distinct cold shapes per workload: exercises more than
+    # one cold digest without paying a fresh graph build per query (the
+    # resolver's graph cache covers them)
+    return {"workload": workload, field: colds[i % len(colds)]}
+
+
+def build_trace(workloads: List[str], n: int, seed: int,
+                mix: Dict[str, float]) -> List[Dict[str, Any]]:
+    """The deterministic query stream: ``n`` request-kwarg dicts drawn
+    from the workload set and the exact/near/cold mix."""
+    rng = random.Random(seed)
+    kinds = sorted(mix)
+    weights = [mix[k] for k in kinds]
+    out = []
+    for i in range(n):
+        wl = rng.choice(workloads)
+        kind = rng.choices(kinds, weights=weights, k=1)[0]
+        out.append({"kind": kind, "request": _req_kwargs(wl, kind, i)})
+    return out
+
+
+def _series(lat_by_tier: Dict[str, List[float]]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for tier, xs in sorted(lat_by_tier.items()):
+        if not xs:
+            continue
+        s = sorted(xs)
+        out[tier] = {
+            "count": len(s),
+            "pct50_us": round(percentile(s, 50), 1),
+            "pct99_us": round(percentile(s, 99), 1),
+            "max_us": round(s[-1], 1),
+            "mean_us": round(sum(s) / len(s), 1),
+        }
+    return out
+
+
+def _warm_stores(workdir: str, csv_globs: Dict[str, List[str]],
+                 topk: int, log) -> Dict[str, Any]:
+    """Warm a monolithic and a segmented store identically from the
+    given corpora; returns paths + per-workload warm summaries."""
+    from tenzing_tpu.bench.driver import DriverRequest
+    from tenzing_tpu.serve.service import ScheduleService
+
+    mono_path = os.path.join(workdir, "mono.json")
+    seg_path = os.path.join(workdir, "seg")
+    summaries: Dict[str, Any] = {}
+    # one surrogate per store (the near tier's pricing model): train it
+    # from the richest corpus only — a later warm with train=True would
+    # overwrite it with the last workload's model
+    primary = "halo" if "halo" in csv_globs else sorted(csv_globs)[0]
+    for store_path, tag in ((mono_path, "mono"), (seg_path, "seg")):
+        svc = ScheduleService(store_path,
+                              queue_dir=os.path.join(workdir, f"q-{tag}"),
+                              tenant=f"replay-{tag}", log=log)
+        for wl, globs in sorted(csv_globs.items()):
+            s = svc.warm(DriverRequest(workload=wl), globs, topk=topk,
+                         train=(wl == primary))
+            summaries.setdefault(wl, {})[tag] = {
+                "added": s["added"], "rows": s["rows"],
+                "admission": s.get("admission"),
+            }
+    return {"mono": mono_path, "seg": seg_path, "warm": summaries}
+
+
+def _replay_legacy(mono_path: str, queue_dir: str, model_path: str,
+                   trace: List[Dict[str, Any]], log) -> Dict[str, Any]:
+    """The pre-PR path, sequentially (process-per-query never had a
+    queue to shed from): per-query materialize + verify, no cache."""
+    from tenzing_tpu.bench.driver import DriverRequest
+    from tenzing_tpu.serve.resolver import Resolver
+    from tenzing_tpu.serve.store import ScheduleStore, WorkQueue
+
+    store = ScheduleStore(mono_path, log=log)
+    model = None
+    if os.path.exists(model_path):
+        from tenzing_tpu.learn import FEATURE_NAMES, RidgeEnsemble
+
+        model = RidgeEnsemble.load(model_path,
+                                   expect_features=list(FEATURE_NAMES))
+    resolver = Resolver(store, queue=WorkQueue(queue_dir), model=model,
+                        serve_cache=False, legacy_verify=True, log=log)
+    reqs = [DriverRequest(**t["request"]) for t in trace]
+    for kw in {json.dumps(t["request"], sort_keys=True)
+               for t in trace}:
+        resolver.resolve(DriverRequest(**json.loads(kw)))  # warmup
+    fallback0 = get_metrics().counter("serve.verify_fallback").value
+    lat: Dict[str, List[float]] = {}
+    t_start = time.perf_counter()
+    for req in reqs:
+        t0 = time.perf_counter()
+        res = resolver.resolve(req)
+        lat.setdefault(res.tier, []).append(
+            (time.perf_counter() - t0) * 1e6)
+    wall = time.perf_counter() - t_start
+    return {
+        "mode": "monolithic-legacy",
+        "resolve_us": _series(lat),
+        "verifier_calls": get_metrics().counter(
+            "serve.verify_fallback").value - fallback0,
+        "wall_s": round(wall, 3),
+        "qps_effective": round(len(reqs) / wall, 1) if wall else None,
+    }
+
+
+def _replay_segmented(seg_path: str, queue_dir: str,
+                      trace: List[Dict[str, Any]], qps: float,
+                      max_pending: int, workers: int,
+                      request_timeout: float, log) -> Dict[str, Any]:
+    """The post-PR path through the real ServeLoop, paced at the target
+    QPS — shed and timeout counts are measured behavior."""
+    from tenzing_tpu.bench.driver import DriverRequest
+    from tenzing_tpu.serve.listen import ListenOpts, ServeLoop
+    from tenzing_tpu.serve.service import ScheduleService
+
+    svc = ScheduleService(seg_path, queue_dir=queue_dir,
+                          tenant="replay-seg", log=log)
+    for kw in {json.dumps(t["request"], sort_keys=True) for t in trace}:
+        svc.query(DriverRequest(**json.loads(kw)))  # warmup
+    fallback0 = get_metrics().counter("serve.verify_fallback").value
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=max_pending, workers=workers,
+        request_timeout_secs=request_timeout,
+        status_path=os.path.join(seg_path, "status-replay.json"),
+        owner="replay", handle_signals=False), log=log)
+    loop.start()
+    results: List[Dict[str, Any]] = []
+    lock = threading.Lock()
+
+    def respond(doc: Dict[str, Any]) -> None:
+        with lock:
+            results.append(doc)
+
+    t_start = time.perf_counter()
+    for i, t in enumerate(trace):
+        target = t_start + i / qps
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        loop.submit({"op": "query", "id": i, "request": t["request"]},
+                    respond)
+    loop.drain(timeout=max(30.0, request_timeout * 2))
+    wall = time.perf_counter() - t_start
+    lat: Dict[str, List[float]] = {}
+    shed = timeouts = errors = cache_hits = 0
+    for doc in results:
+        if doc.get("shed"):
+            shed += 1
+        elif doc.get("timed_out"):
+            timeouts += 1
+        elif not doc.get("ok"):
+            errors += 1
+        else:
+            r = doc["result"]
+            lat.setdefault(r["tier"], []).append(r["resolve_us"])
+            if r.get("provenance", {}).get("cache_hit"):
+                cache_hits += 1
+    return {
+        "mode": "segmented",
+        "resolve_us": _series(lat),
+        "verifier_calls": get_metrics().counter(
+            "serve.verify_fallback").value - fallback0,
+        "shed": shed,
+        "timeouts": timeouts,
+        "errors": errors,
+        "exact_cache_hits": cache_hits,
+        "wall_s": round(wall, 3),
+        "qps_effective": round(len(trace) / wall, 1) if wall else None,
+        "counters": dict(loop.counters),
+    }
+
+
+def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
+               qps: float = 500.0, seed: int = 7,
+               mix: Optional[Dict[str, float]] = None, topk: int = 3,
+               workdir: Optional[str] = None, keep_workdir: bool = False,
+               max_pending: int = 256, workers: int = 2,
+               request_timeout: float = 30.0,
+               log=None) -> Dict[str, Any]:
+    """The whole benchmark; returns the result document (see module
+    docstring)."""
+    mix = mix or {"exact": 0.8, "near": 0.15, "cold": 0.05}
+    workloads = sorted(csv_globs)
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="tz_serve_replay.")
+    try:
+        stores = _warm_stores(workdir, csv_globs, topk, log)
+        trace = build_trace(workloads, n, seed, mix)
+        legacy = _replay_legacy(
+            stores["mono"], os.path.join(workdir, "q-mono"),
+            stores["mono"] + ".model.json", trace, log)
+        seg = _replay_segmented(
+            stores["seg"], os.path.join(workdir, "q-seg"), trace, qps,
+            max_pending, workers, request_timeout, log)
+        speedup = None
+        le = legacy["resolve_us"].get("exact")
+        se = seg["resolve_us"].get("exact")
+        if le and se and se["pct99_us"] > 0:
+            speedup = round(le["pct99_us"] / se["pct99_us"], 2)
+        return {
+            "kind": "serve_trace_replay",
+            "version": REPLAY_VERSION,
+            "n": n, "qps": qps, "seed": seed, "mix": mix,
+            "workloads": workloads,
+            "warm": stores["warm"],
+            "monolithic": legacy,
+            "segmented": seg,
+            "exact_pct99_speedup": speedup,
+        }
+    finally:
+        if own_workdir and not keep_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tenzing_tpu.serve.replay",
+        description="Replay a synthetic high-QPS query trace against the "
+                    "legacy monolithic and the segmented serving paths "
+                    "(docs/serving.md 'Trace-replay benchmark').")
+    ap.add_argument("--halo-csv", nargs="*", default=None, metavar="GLOB",
+                    help="halo recorded databases (default: the "
+                         "committed experiments/halo_search_tpu_r[45]* "
+                         "corpus)")
+    ap.add_argument("--spmv-csv", nargs="*", default=None, metavar="GLOB",
+                    help="spmv recorded databases (default: the "
+                         "committed experiments/spmv_search_tpu.csv)")
+    ap.add_argument("--n", type=int, default=1200,
+                    help="queries in the trace")
+    ap.add_argument("--qps", type=float, default=500.0,
+                    help="paced submission rate for the segmented path")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--mix", default="exact=0.8,near=0.15,cold=0.05",
+                    help="tier-class mix, k=v comma list")
+    ap.add_argument("--topk", type=int, default=3,
+                    help="winners warmed per workload")
+    ap.add_argument("--max-pending", type=int, default=256)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--request-timeout", type=float, default=30.0)
+    ap.add_argument("--workdir", default=None,
+                    help="keep stores/queues here (default: temp, "
+                         "removed)")
+    ap.add_argument("--out", default=None,
+                    help="write the result document here (e.g. "
+                         "SERVE_BENCH_r01.json)")
+    args = ap.parse_args(argv)
+    mix: Dict[str, float] = {}
+    for part in args.mix.split(","):
+        k, _, v = part.partition("=")
+        mix[k.strip()] = float(v)
+    csv_globs: Dict[str, List[str]] = {}
+    halo = (args.halo_csv if args.halo_csv is not None
+            else ["experiments/halo_search_tpu_r[45]*.csv"])
+    spmv = (args.spmv_csv if args.spmv_csv is not None
+            else ["experiments/spmv_search_tpu.csv"])
+    if halo:
+        csv_globs["halo"] = halo
+    if spmv:
+        csv_globs["spmv"] = spmv
+    doc = run_replay(csv_globs, n=args.n, qps=args.qps, seed=args.seed,
+                     mix=mix, topk=args.topk, workdir=args.workdir,
+                     keep_workdir=args.workdir is not None,
+                     max_pending=args.max_pending, workers=args.workers,
+                     request_timeout=args.request_timeout,
+                     log=lambda m: sys.stderr.write(m + "\n"))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        sys.stderr.write(f"replay: {args.out}\n")
+    sys.stdout.write(json.dumps({
+        "exact_pct99_speedup": doc["exact_pct99_speedup"],
+        "monolithic_exact": doc["monolithic"]["resolve_us"].get("exact"),
+        "segmented_exact": doc["segmented"]["resolve_us"].get("exact"),
+        "segmented_verifier_calls": doc["segmented"]["verifier_calls"],
+        "shed": doc["segmented"]["shed"],
+        "timeouts": doc["segmented"]["timeouts"],
+    }) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,12 +94,21 @@ class Resolver:
                  model=None, near_max_sigma: float = 0.75,
                  verify: bool = True,
                  graph_builder: Optional[Callable] = None,
-                 log: Optional[Callable[[str], None]] = None):
+                 log: Optional[Callable[[str], None]] = None,
+                 serve_cache: bool = True,
+                 legacy_verify: bool = False):
         self.store = store
         self.queue = queue
         self.model = model
         self.near_max_sigma = float(near_max_sigma)
         self.verify = verify
+        # serve_cache=False disables the exact-tier sealed-record cache;
+        # legacy_verify=True additionally ignores admission stamps and
+        # re-verifies every exact hit — together they replay the pre-PR
+        # resolution path exactly (the trace-replay benchmark's baseline,
+        # serve/replay.py; never the serving configuration)
+        self.serve_cache = serve_cache
+        self.legacy_verify = legacy_verify
         self._graph_builder = graph_builder
         # per-exact-digest caches, BOUNDED: the digests are derived from
         # client-controlled shape parameters, and a long-lived server
@@ -107,8 +116,18 @@ class Resolver:
         # not grow without limit — insertion-order eviction is enough
         # because serving traffic concentrates on few fingerprints
         self.cache_cap = 32
+        # the exact-tier answer cache is the serving hot path (one dict
+        # probe per hit) and its entries are small (a record reference +
+        # a materialized Sequence): it earns a much larger bound
+        self.exact_cache_cap = 4096
         self._graphs: Dict[str, Tuple[Any, Dict[str, int]]] = {}
         self._verifiers: Dict[str, Any] = {}
+        # exact digest -> (record, sequence, provenance) of the admitted
+        # best answer; validity keyed on the store's generation counter
+        # (any record landing anywhere invalidates wholesale — coarse,
+        # but merges are rare and wrong answers are forever)
+        self._exact_cache: Dict[str, Tuple[Record, Any, Dict[str, Any]]] = {}
+        self._exact_cache_gen = -1
         # (model, surrogate) per exact digest: the surrogate's
         # canonical-key prediction cache must survive across queries of
         # a hot fingerprint (re-featurizing the same neighbors per
@@ -121,8 +140,16 @@ class Resolver:
         if self._log is not None:
             self._log(msg)
 
-    def _cache_put(self, cache: Dict[str, Any], key: str, value) -> None:
-        while len(cache) >= self.cache_cap:
+    def _cache_put(self, cache: Dict[str, Any], key: str, value,
+                   cap: Optional[int] = None) -> None:
+        if key in cache:
+            # re-put of a present key must update in place: evicting an
+            # oldest entry for it would shrink the cache by one per
+            # refresh (and could evict the very entry being refreshed)
+            cache[key] = value
+            return
+        cap = self.cache_cap if cap is None else cap
+        while len(cache) >= cap:
             cache.pop(next(iter(cache)))  # oldest insertion
         cache[key] = value
 
@@ -161,20 +188,60 @@ class Resolver:
 
     # -- tiers ---------------------------------------------------------------
     def _try_exact(self, req, fp: WorkloadFingerprint) -> Optional[Resolution]:
+        reg = get_metrics()
+        if self.serve_cache:
+            hit = self._exact_cache.get(fp.exact_digest)
+            if hit is not None and hit[0].get("flags", {}).get("unsound"):
+                # belt-and-braces behind the generation check: a record
+                # flagged between the generation bump and this probe (or
+                # by a caller holding the same dict) must never be served
+                self._exact_cache.pop(fp.exact_digest, None)
+                hit = None
+            if hit is not None:
+                # the hot path: one dict probe, zero materializations,
+                # zero verifier invocations — the record was admitted
+                # (verified + sealed) when it entered the cache
+                rec, seq, prov = hit
+                reg.counter("serve.exact_cache.hits").inc()
+                return Resolution(tier="exact", fingerprint=fp, record=rec,
+                                  sequence=seq,
+                                  pct50_us=rec.get("pct50_us"),
+                                  vs_naive=rec.get("vs_naive"),
+                                  provenance=dict(prov, cache_hit=True))
         records = self.store.exact_records(fp.exact_digest)
         if not records:
             return None
-        graph, _ = self._graph(req, fp)
+        if self.serve_cache:
+            reg.counter("serve.exact_cache.misses").inc()
+        graph = None
         # best-first WALK, not best-only: one unsound or unresolvable
         # best record must not permanently block a sound runner-up under
         # the same exact digest (the near tier excludes the requester's
         # own digest, so falling through here would skip it entirely)
         for rec in records:
+            if rec.get("flags", {}).get("unsound"):
+                # flagged at admission (or by a prior discovery): never
+                # served, and never worth re-verifying — the verdict is
+                # deterministic
+                continue
+            if graph is None:
+                graph, _ = self._graph(req, fp)
             seq = self._materialize(rec, graph)
             if seq is None:
                 continue
+            admission_stamped = (bool(rec.get("verified_at_admission"))
+                                 and not self.legacy_verify)
             verified = None
-            if self.verify:
+            verifier_calls = 0
+            if admission_stamped:
+                # verified once when it was merged into the store, under
+                # this same fingerprint's (deterministic) graph — serving
+                # it again needs no second opinion (docs/serving.md
+                # "Admission-time verification")
+                verified = True
+            elif self.verify:
+                verifier_calls = 1
+                reg.counter("serve.verify_fallback").inc()
                 verdict = self._verifier(graph, fp)(seq)
                 verified = bool(verdict.ok)
                 if not verified:
@@ -188,14 +255,28 @@ class Resolver:
                                "failed re-verification — flagged, "
                                "not served")
                     continue
+                # the lazy-verified record is now as good as stamped for
+                # this process's lifetime (in-memory only: persistence of
+                # the stamp belongs to admission, not resolution); the
+                # legacy replay path must not stamp — it would leak
+                # new-path state into the baseline it exists to measure
+                if not self.legacy_verify:
+                    rec["verified_at_admission"] = True
             prov = {
                 "verified": verified,
+                "verified_at_admission": admission_stamped,
+                "verifier_calls": verifier_calls,
+                "cache_hit": False,
                 "was_predicted": False,
                 "compiles": 0,
                 "measurements": 0,
                 "source_exact": rec["exact"],
                 **rec.get("provenance", {}),
             }
+            if self.serve_cache and verified is not False:
+                self._cache_put(self._exact_cache, fp.exact_digest,
+                                (rec, seq, prov),
+                                cap=self.exact_cache_cap)
             return Resolution(tier="exact", fingerprint=fp, record=rec,
                               sequence=seq, pct50_us=rec.get("pct50_us"),
                               vs_naive=rec.get("vs_naive"),
@@ -220,6 +301,8 @@ class Resolver:
         else:
             surrogate = ent[1]
         for rec in neighbors:
+            if rec.get("flags", {}).get("unsound"):
+                continue  # same rule as the exact tier: known-bad, skip
             seq = self._materialize(rec, graph)
             if seq is None:
                 continue
@@ -296,6 +379,13 @@ class Resolver:
         reg = get_metrics()
         tr = get_tracer()
         t0 = time.perf_counter()
+        gen = getattr(self.store, "generation", 0)
+        if gen != self._exact_cache_gen:
+            # any record landing anywhere (add/merge/load) invalidates
+            # the whole answer cache: coarse, but merges are rare and a
+            # stale answer would outlive the better record that beat it
+            self._exact_cache.clear()
+            self._exact_cache_gen = gen
         fp = fingerprint_of(req)
         with tr.span("serve.query", workload=fp.workload,
                      exact=fp.exact_digest, bucket=fp.bucket_digest) as sp:

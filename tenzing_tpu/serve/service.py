@@ -35,14 +35,16 @@ from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.serve.fingerprint import fingerprint_of, schedule_key
 from tenzing_tpu.serve.resolver import Resolution, Resolver
-from tenzing_tpu.serve.store import ScheduleStore, WorkQueue
+from tenzing_tpu.serve.store import ScheduleStore, WorkQueue, open_store
 
 
 def default_model_path(store_path: str) -> str:
     """Where ``warm --train`` saves the surrogate next to its store —
     one convention shared by the CLI and the service so a warmed store
-    directory is self-contained."""
-    return store_path + ".model.json"
+    directory is self-contained.  Works for both backends: a trailing
+    separator on a segmented store *directory* is stripped so the model
+    lands beside the store, never hidden inside it."""
+    return store_path.rstrip(os.sep).rstrip("/") + ".model.json"
 
 
 class ScheduleService:
@@ -66,8 +68,12 @@ class ScheduleService:
                  verify: bool = True, near_max_sigma: float = 0.75,
                  log: Optional[Callable[[str], None]] = None):
         self._log = log
-        self.store = ScheduleStore(store_path, tenant=tenant, log=log)
+        # .json paths open the legacy monolithic store; anything else
+        # opens the segmented store (serve/store.py open_store — one
+        # dispatch rule for every entry point)
+        self.store = open_store(store_path, tenant=tenant, log=log)
         self.queue = WorkQueue(queue_dir) if queue_dir else None
+        self.verify = verify
         self.model_path = model_path or default_model_path(store_path)
         self.model = self._load_model()
         self.resolver = Resolver(self.store, queue=self.queue,
@@ -105,7 +111,8 @@ class ScheduleService:
             # never drift on which recorded rows count
             scored, stats = scored_rows(paths, graph, log=self._note)
             seen: set = set()
-            added = 0
+            added = rejected = 0
+            verifier = None
             for ratio, pct50, seq, path in scored:
                 if added >= topk:
                     break
@@ -113,14 +120,44 @@ class ScheduleService:
                 if key in seen:
                     continue
                 seen.add(key)
+                # ADMISSION-TIME verification (docs/serving.md): verify
+                # once, here, under this fingerprint's graph — the exact
+                # tier then serves the stamped record with zero per-query
+                # verifier invocations.  An unsound row is stored flagged
+                # (visible in stats/report, never served, never counted
+                # against topk) — the PR-7 never-serve-unsound guarantee
+                # moves to the door instead of being re-proved per query.
+                verified = None
+                if self.verify:
+                    if verifier is None:
+                        from tenzing_tpu.verify import ScheduleVerifier
+
+                        verifier = ScheduleVerifier(graph)
+                    verified = bool(verifier(seq).ok)
+                    if not verified:
+                        get_metrics().counter(
+                            "serve.admission.unsound").inc()
+                        self._note(f"serve: admission rejected unsound "
+                                   f"{key[:8]} from "
+                                   f"{os.path.basename(path)} — stored "
+                                   "flagged, never served")
+                        self.store.add(fp, seq, pct50_us=pct50 * 1e6,
+                                       vs_naive=ratio, source=path,
+                                       verified=False)
+                        rejected += 1
+                        continue
+                    get_metrics().counter("serve.admission.verified").inc()
                 self.store.add(fp, seq, pct50_us=pct50 * 1e6,
-                               vs_naive=ratio, source=path)
+                               vs_naive=ratio, source=path,
+                               verified=verified)
                 added += 1
             summary: Dict[str, Any] = {
                 "workload": req.workload, "exact": fp.exact_digest,
                 "bucket": fp.bucket_digest, "files": stats["files"],
                 "rows": stats["rows"], "candidates": len(scored),
                 "added": added,
+                "admission": {"verified": added if self.verify else None,
+                              "rejected_unsound": rejected},
             }
             if bench_globs:
                 summary["driver_provenance"] = self._stamp_driver_jsons(

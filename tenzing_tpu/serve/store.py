@@ -117,6 +117,12 @@ def merge_records(a: Record, b: Record) -> Record:
                             **win.get("provenance", {})}
     winner["sources"] = sorted(
         set(a.get("sources", [])) | set(b.get("sources", [])))
+    # the admission-time verification stamp ORs sticky, exactly like a
+    # flag: two spellings of one (exact, key) slot name the SAME schedule
+    # under the SAME deterministic graph, so a verdict from either host
+    # covers both (docs/serving.md "Admission-time verification")
+    if a.get("verified_at_admission") or b.get("verified_at_admission"):
+        winner["verified_at_admission"] = True
     flags: Dict[str, bool] = {}
     for src in (a.get("flags", {}), b.get("flags", {})):
         for k, v in src.items():
@@ -149,6 +155,10 @@ class ScheduleStore:
         self._count_metrics = _count_metrics
         self.entries: Dict[str, Dict[str, Record]] = {}
         self.skipped = 0  # records dropped by validation/migration on load
+        # bumped on every record landing (_put: load, add, merge) — the
+        # resolver's exact-tier cache keys its validity on this, so a
+        # merge can never serve a stale cached answer
+        self.generation = 0
         if path is not None and os.path.exists(path):
             self._load(path)
 
@@ -216,14 +226,22 @@ class ScheduleStore:
         slot = self.entries.setdefault(rec["exact"], {})
         prev = slot.get(rec["key"])
         slot[rec["key"]] = rec if prev is None else merge_records(prev, rec)
+        self.generation += 1
         return slot[rec["key"]]
 
     def add(self, fingerprint, seq, pct50_us: float, vs_naive: float,
             source: Optional[str] = None, fidelity: str = "full",
-            extra_provenance: Optional[Dict[str, Any]] = None) -> Record:
+            extra_provenance: Optional[Dict[str, Any]] = None,
+            verified: Optional[bool] = None) -> Record:
         """Record ``seq`` (a Sequence) as a winner for ``fingerprint``.
         ``source`` is the corpus file it was mined from (digested into
-        ``sources``)."""
+        ``sources``).  ``verified`` is the **admission-time** soundness
+        verdict (docs/serving.md): ``True`` stamps
+        ``verified_at_admission`` (the exact tier serves it with zero
+        per-query verifier invocations), ``False`` flags the record
+        ``unsound`` (stored for visibility, never served, never cached),
+        ``None`` leaves it unstamped (the resolver verifies lazily,
+        once)."""
         from tenzing_tpu.bench.benchmarker import schedule_id
         from tenzing_tpu.core.serdes import sequence_to_json
         from tenzing_tpu.serve.fingerprint import schedule_key
@@ -250,6 +268,10 @@ class ScheduleStore:
                         else []),
             "flags": {},
         }
+        if verified is True:
+            rec["verified_at_admission"] = True
+        elif verified is False:
+            rec["flags"]["unsound"] = True
         get_metrics().counter("serve.store.added").inc()
         return self._put(rec)
 
@@ -266,6 +288,10 @@ class ScheduleStore:
         if all(cur.get(k) == v for k, v in flags.items()):
             return
         cur.update(flags)
+        # a flag mutation changes what may be served (unsound above
+        # all): the resolver's exact cache must see it as a new
+        # generation, same as a record landing
+        self.generation += 1
         self.flush()
 
     # -- queries ------------------------------------------------------------
@@ -383,6 +409,24 @@ class ScheduleStore:
             "tenants": sorted(tenants),
             "skipped_on_load": self.skipped,
         }
+
+
+def open_store(path: Optional[str], **kwargs) -> "ScheduleStore":
+    """THE store-backend dispatcher: a ``*.json`` path opens the legacy
+    monolithic :class:`ScheduleStore` (every committed store, the daemon
+    smokes, old CLIs keep working unchanged); anything else — a
+    directory, existing or to-be-created — opens the segmented store
+    (serve/segments.py, docs/serving.md "Segmented store").  One rule,
+    used by the service, the CLI, the report CLI, and the replay
+    benchmark, so no two entry points can disagree about what a store
+    path means."""
+    if path is None:
+        return ScheduleStore(None, **kwargs)
+    if path.endswith(".json") and not os.path.isdir(path):
+        return ScheduleStore(path, **kwargs)
+    from tenzing_tpu.serve.segments import SegmentedStore
+
+    return SegmentedStore(path, **kwargs)
 
 
 class WorkQueue:

@@ -18,13 +18,33 @@ import tempfile
 from typing import Any, Dict
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a *directory* so a just-landed rename/link inside it is
+    durable (POSIX: the rename itself lives in the directory's metadata;
+    crash-consistency of the segmented store's publish steps depends on
+    it — serve/segments.py).  Best-effort: platforms that refuse to open
+    a directory (or to fsync one) degrade to the pre-existing behavior
+    rather than failing the write that already landed."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def atomic_dump_json(path: str, doc: Dict[str, Any],
                      prefix: str = ".atomic.") -> None:
     """Atomically write ``doc`` as sorted-key JSON to ``path``.
 
     The temp file is created in the destination directory (rename must not
     cross filesystems), fsynced before the rename, and unlinked on any
-    failure so aborted writes leave no droppings."""
+    failure so aborted writes leave no droppings; the directory is fsynced
+    after the rename so the publish itself is durable."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix, suffix=".tmp")
@@ -34,6 +54,7 @@ def atomic_dump_json(path: str, doc: Dict[str, Any],
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        fsync_dir(d)
     except BaseException:
         try:
             os.unlink(tmp)

@@ -1,0 +1,347 @@
+"""Listen-mode service loop + admission-serving acceptance (ISSUE 11;
+docs/serving.md "Listen mode"): exact hits served from the sealed cache
+with ZERO per-query verifier invocations, lazy verification exactly once
+for unstamped records, unsound-at-admission never served, bounded-queue
+load shedding with retry_after, the per-request watchdog, graceful
+drain + status doc, the batch op, the socket transport, and the
+resolver bounded-cache re-put fix.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from tenzing_tpu.bench.driver import DriverRequest, graph_for
+from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.serve.fingerprint import fingerprint_of, schedule_key
+from tenzing_tpu.serve.listen import ListenOpts, ServeLoop
+from tenzing_tpu.serve.resolver import Resolver
+from tenzing_tpu.serve.service import ScheduleService
+from tenzing_tpu.serve.store import ScheduleStore
+
+REQ = DriverRequest(workload="spmv", m=512)
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """A synthetic recorded database for spmv/512 (the same shape the
+    serving tests mine) — row 0 the naive anchor, then distinct 2-lane
+    schedules beating it."""
+    import itertools
+
+    from tenzing_tpu.bench.benchmarker import BenchResult, result_row
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import State
+
+    d = tmp_path_factory.mktemp("listen_corpus")
+    g, _ = graph_for(REQ)
+
+    def drive(n_lanes, picks):
+        plat = Platform.make_n_lanes(n_lanes)
+        st = State(g)
+        i = 0
+        while not st.is_terminal():
+            ds = st.get_decisions(plat)
+            st = st.apply(ds[picks[i % len(picks)] % len(ds)])
+            i += 1
+        return st.sequence
+
+    naive = drive(1, [0])
+    alts, seen = [], set()
+    for picks in itertools.product((0, 1, 2), repeat=3):
+        s = drive(2, list(picks))
+        k = schedule_key(s)
+        if k not in seen:
+            seen.add(k)
+            alts.append(s)
+        if len(alts) >= 6:
+            break
+    rows = [result_row(0, BenchResult.from_times([2.0, 2.1, 2.05]), naive)]
+    for i, a in enumerate(alts):
+        t = 1.0 + 0.1 * i
+        rows.append(result_row(
+            i + 1, BenchResult.from_times([t, t * 1.02, t * 0.99]), a))
+    path = d / "spmv_search.csv"
+    path.write_text("\n".join(rows) + "\n")
+    return {"csv": str(path), "graph": g, "alts": alts}
+
+
+@pytest.fixture()
+def warmed(tmp_path, corpus):
+    """A freshly-warmed SEGMENTED service per test (the exact cache and
+    counters are per-instance state)."""
+    svc = ScheduleService(str(tmp_path / "store"),
+                          queue_dir=str(tmp_path / "queue"))
+    summary = svc.warm(REQ, [corpus["csv"]], topk=2, train=False)
+    assert summary["added"] == 2
+    assert summary["admission"] == {"verified": 2, "rejected_unsound": 0}
+    return svc
+
+
+# -- admission-time verification / exact cache -------------------------------
+
+def test_exact_hit_zero_verifier_calls_then_cache(warmed):
+    fallback0 = get_metrics().counter("serve.verify_fallback").value
+    r1 = warmed.query(REQ)
+    assert r1.tier == "exact"
+    p = r1.provenance
+    assert p["verified"] is True
+    assert p["verified_at_admission"] is True
+    assert p["verifier_calls"] == 0
+    assert p["cache_hit"] is False
+    assert p["compiles"] == 0 and p["measurements"] == 0
+    r2 = warmed.query(REQ)
+    assert r2.provenance["cache_hit"] is True
+    assert r2.sequence is r1.sequence  # the sealed cached answer
+    assert get_metrics().counter("serve.verify_fallback").value == fallback0
+
+
+def test_unstamped_record_lazy_verifies_exactly_once(tmp_path, corpus):
+    """A record that arrived without an admission stamp (e.g. merged
+    from a legacy store) is verified lazily on first serve, then cached
+    — one verifier invocation total, not one per query."""
+    store = ScheduleStore(str(tmp_path / "legacy.json"))
+    fp = fingerprint_of(REQ)
+    store.add(fp, corpus["alts"][0], pct50_us=10.0, vs_naive=2.0)  # no stamp
+    resolver = Resolver(store, graph_builder=lambda r: (corpus["graph"], {}))
+    fallback0 = get_metrics().counter("serve.verify_fallback").value
+    r1 = resolver.resolve(REQ)
+    assert r1.tier == "exact"
+    assert r1.provenance["verifier_calls"] == 1
+    assert r1.provenance["verified_at_admission"] is False
+    r2 = resolver.resolve(REQ)
+    assert r2.provenance["cache_hit"] is True
+    assert get_metrics().counter(
+        "serve.verify_fallback").value == fallback0 + 1
+
+
+def test_store_mutation_invalidates_exact_cache(warmed, corpus):
+    r1 = warmed.query(REQ)
+    assert warmed.query(REQ).provenance["cache_hit"] is True
+    # a merge/add anywhere bumps the store generation: the next query
+    # re-walks the records instead of serving a possibly-beaten answer
+    fp = fingerprint_of(REQ)
+    warmed.store.add(fp, corpus["alts"][-1], pct50_us=0.5, vs_naive=9.0,
+                     verified=True)
+    r3 = warmed.query(REQ)
+    assert r3.provenance["cache_hit"] is False
+    assert r3.vs_naive == 9.0  # the better record won, not the stale one
+    assert r1.vs_naive != 9.0
+
+
+def test_flagging_served_record_invalidates_exact_cache(warmed):
+    """A record flagged unsound AFTER it was cached must never be
+    served again: flag() bumps the store generation (and the hit path
+    re-checks flags), so the runner-up answers instead."""
+    r1 = warmed.query(REQ)
+    assert r1.tier == "exact"
+    assert warmed.query(REQ).provenance["cache_hit"] is True
+    warmed.store.flag(r1.record["exact"], r1.record["key"], unsound=True)
+    r2 = warmed.query(REQ)
+    assert r2.tier == "exact"
+    assert r2.record["key"] != r1.record["key"], \
+        "flagged-unsound record served from the stale cache"
+
+
+def test_unsound_at_admission_stored_flagged_never_served(tmp_path, corpus):
+    """An unsound record is admitted flagged (visible) and skipped by
+    the exact tier without any verifier call."""
+    svc = ScheduleService(str(tmp_path / "store"),
+                          queue_dir=str(tmp_path / "queue"))
+    fp = fingerprint_of(REQ)
+    svc.store.add(fp, corpus["alts"][0], pct50_us=1.0, vs_naive=9.0,
+                  verified=False)   # flagged unsound at admission
+    svc.store.add(fp, corpus["alts"][1], pct50_us=2.0, vs_naive=1.5,
+                  verified=True)    # the sound runner-up
+    svc.store.flush()
+    fallback0 = get_metrics().counter("serve.verify_fallback").value
+    res = svc.query(REQ)
+    assert res.tier == "exact"
+    assert res.vs_naive == 1.5  # the unsound 9.0 "best" never served
+    assert res.provenance["verifier_calls"] == 0
+    assert get_metrics().counter("serve.verify_fallback").value == fallback0
+    st = svc.store.stats()
+    assert st["admission"]["unsound"] == 1
+
+
+def test_cache_put_represent_key_updates_in_place(corpus):
+    """The satellite fix: re-putting a present key at cap must update in
+    place, not evict an oldest entry (which shrank the cache by one and
+    could evict the very entry being refreshed)."""
+    r = Resolver(ScheduleStore(None))
+    r.cache_cap = 3
+    cache = {}
+    for k in ("a", "b", "c"):
+        r._cache_put(cache, k, k.upper())
+    assert list(cache) == ["a", "b", "c"]
+    r._cache_put(cache, "b", "B2")   # re-put at cap
+    assert cache == {"a": "A", "b": "B2", "c": "C"}  # nothing evicted
+    r._cache_put(cache, "d", "D")    # a genuinely new key still evicts
+    assert list(cache) == ["b", "c", "d"]
+
+
+# -- the serve loop ----------------------------------------------------------
+
+class _StubService:
+    """A service whose query latency the tests control."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.store = ScheduleStore(None)
+        self.calls = 0
+
+    def query(self, req):
+        from tenzing_tpu.serve.resolver import Resolution
+
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        return Resolution(tier="exact", fingerprint=fingerprint_of(REQ),
+                          provenance={"stub": True})
+
+    def stats(self):
+        return {"stub": True}
+
+
+def _collect():
+    docs, lock = [], threading.Lock()
+
+    def respond(doc):
+        with lock:
+            docs.append(doc)
+
+    return docs, respond
+
+
+def test_bounded_queue_sheds_with_retry_after(tmp_path):
+    svc = _StubService(delay=0.4)
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=1, workers=1, request_timeout_secs=30.0,
+        shed_retry_after_secs=0.125, handle_signals=False,
+        status_path=str(tmp_path / "status.json")))
+    shed0 = get_metrics().counter("serve.shed").value
+    loop.start()
+    docs, respond = _collect()
+    for i in range(4):
+        loop.submit({"op": "query", "id": i,
+                     "request": {"workload": "spmv", "m": 512}}, respond)
+    loop.drain(timeout=10.0)
+    shed = [d for d in docs if d.get("shed")]
+    ok = [d for d in docs if d.get("ok")]
+    assert len(docs) == 4
+    assert shed, "nothing shed at max_pending=1"
+    assert all(d["retry_after"] == 0.125 for d in shed)
+    assert all(d["error_class"] == "transient" for d in shed)
+    assert len(ok) + len(shed) == 4
+    assert loop.counters["shed"] == len(shed)
+    assert get_metrics().counter("serve.shed").value == shed0 + len(shed)
+
+
+def test_watchdog_times_out_stuck_request(tmp_path):
+    svc = _StubService(delay=1.0)
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=4, workers=1, request_timeout_secs=0.2,
+        handle_signals=False, status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    loop.submit({"op": "query", "id": 1,
+                 "request": {"workload": "spmv", "m": 512}}, respond)
+    t0 = time.time()
+    while not docs and time.time() - t0 < 5.0:
+        time.sleep(0.02)
+    assert docs, "watchdog never answered"
+    assert docs[0]["timed_out"] is True
+    assert docs[0]["error_class"] == "transient"
+    assert time.time() - t0 < 0.9  # answered before the worker finished
+    loop.drain(timeout=10.0)
+    assert len(docs) == 1  # the late worker result was discarded
+    assert loop.counters["timeouts"] == 1
+
+
+def test_graceful_drain_answers_queued_and_stamps_status(tmp_path):
+    svc = _StubService(delay=0.05)
+    status = str(tmp_path / "status.json")
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=16, workers=2, request_timeout_secs=30.0,
+        handle_signals=False, status_path=status))
+    loop.start()
+    docs, respond = _collect()
+    for i in range(6):
+        loop.submit({"op": "query", "id": i,
+                     "request": {"workload": "spmv", "m": 512}}, respond)
+    loop.stop()
+    # intake stopped: a post-stop submit is shed as "draining"
+    loop.submit({"op": "query", "id": 99,
+                 "request": {"workload": "spmv", "m": 512}}, respond)
+    assert loop.drain(timeout=10.0) is True
+    assert len(docs) == 7
+    assert sum(1 for d in docs if d.get("ok")) == 6
+    assert [d for d in docs if d.get("shed")][0]["reason"] == "draining"
+    st = json.load(open(status))
+    assert st["kind"] == "serve_loop" and st["state"] == "stopped"
+    assert st["counters"]["requests"] == 7
+
+
+def test_batch_and_malformed_ops(warmed, tmp_path):
+    loop = ServeLoop(warmed, ListenOpts(
+        max_pending=8, workers=1, request_timeout_secs=60.0,
+        handle_signals=False, status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    loop.submit({"op": "batch", "id": 1, "requests": [
+        {"workload": "spmv", "m": 512},
+        {"request": {"workload": "spmv", "m": 512}}]}, respond)
+    loop.submit({"op": "nope", "id": 2}, respond)
+    loop.drain(timeout=30.0)
+    by_id = {d.get("id"): d for d in docs}
+    assert len(by_id[1]["results"]) == 2
+    assert by_id[1]["results"][0]["tier"] == "exact"
+    assert by_id[1]["results"][1]["provenance"]["cache_hit"] is True
+    assert "resolve_us" in by_id[1]["results"][0]
+    assert by_id[2]["ok"] is False
+    assert by_id[2]["error_class"] == "deterministic"
+    assert loop.counters["batches"] == 1
+    assert loop.counters["malformed"] == 1
+
+
+def test_socket_transport_round_trip(warmed, tmp_path):
+    sock_path = str(tmp_path / "serve.sock")
+    loop = ServeLoop(warmed, ListenOpts(
+        max_pending=8, workers=1, request_timeout_secs=60.0,
+        handle_signals=False, socket_path=sock_path,
+        status_path=str(tmp_path / "status.json")))
+    result = {}
+
+    def run():
+        result["summary"] = loop.serve_socket(sock_path)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while not os.path.exists(sock_path) and time.time() < deadline:
+        time.sleep(0.02)
+    cli = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    cli.connect(sock_path)
+    cli.sendall((json.dumps({"op": "query", "id": 7, "request": {
+        "workload": "spmv", "m": 512}}) + "\n"
+        + json.dumps({"op": "ping", "id": 8}) + "\n").encode())
+    cli.settimeout(60.0)
+    buf = b""
+    while buf.count(b"\n") < 2:
+        chunk = cli.recv(1 << 16)
+        assert chunk, "server closed early"
+        buf += chunk
+    docs = {d["id"]: d for d in
+            (json.loads(l) for l in buf.decode().splitlines())}
+    assert docs[7]["result"]["tier"] == "exact"
+    assert docs[8]["pong"] is True
+    cli.close()
+    loop.stop()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert result["summary"]["counters"]["requests"] == 2
+    assert not os.path.exists(sock_path)  # cleaned up on exit

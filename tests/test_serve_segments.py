@@ -1,0 +1,435 @@
+"""Segmented-store acceptance (ISSUE 11; docs/serving.md "Segmented
+store"): backend parity with the monolithic store, flush cost bound to
+the dirty set, every corruption path recovered to a superset and never
+fatal (truncated segment, bit-flipped record, torn manifest,
+mid-compaction SIGKILL), compaction crash-consistency + lease
+exclusivity, and the report CLI strictly read-only against a damaged
+tree.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tenzing_tpu.bench.driver import DriverRequest, graph_for
+from tenzing_tpu.serve.fingerprint import fingerprint_of
+from tenzing_tpu.serve.lease import LeaseFile
+from tenzing_tpu.serve.segments import (
+    Compactor,
+    SegmentedStore,
+    record_digest,
+    segment_bucket_of,
+)
+from tenzing_tpu.serve.store import (
+    RECORD_SCHEMA,
+    ScheduleStore,
+    merge_records,
+    open_store,
+)
+
+
+@pytest.fixture(scope="module")
+def spmv():
+    """(graph, fingerprints, sequences) — the same neighborhood the
+    monolithic store tests drive (tests/test_serve_store.py)."""
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import State
+
+    req = DriverRequest(workload="spmv", m=512)
+    g, _ = graph_for(req)
+
+    def drive(picks, n_lanes=2):
+        plat = Platform.make_n_lanes(n_lanes)
+        st = State(g)
+        i = 0
+        while not st.is_terminal():
+            ds = st.get_decisions(plat)
+            st = st.apply(ds[picks[i % len(picks)] % len(ds)])
+            i += 1
+        return st.sequence
+
+    fps = {
+        "a": fingerprint_of(req),
+        "b": fingerprint_of(DriverRequest(workload="spmv", m=500)),
+        # a different bucket entirely (m=100000 buckets to 131072)
+        "c": fingerprint_of(DriverRequest(workload="spmv", m=100000)),
+    }
+    seqs = [drive(p) for p in ([0], [1, 2, 0], [2, 1, 0], [1, 0, 2])]
+    return g, fps, seqs
+
+
+def _seg_files(store_dir):
+    segdir = os.path.join(store_dir, "segments")
+    if not os.path.isdir(segdir):
+        return []
+    return sorted(n for n in os.listdir(segdir)
+                  if n.startswith("seg-") and n.endswith(".jsonl"))
+
+
+def _records_doc(store):
+    return json.dumps(sorted(json.dumps(r, sort_keys=True)
+                             for r in store.records()))
+
+
+# -- parity + dispatch -------------------------------------------------------
+
+def test_open_store_dispatch(tmp_path):
+    assert isinstance(open_store(str(tmp_path / "s.json")), ScheduleStore)
+    seg = open_store(str(tmp_path / "segdir"))
+    assert isinstance(seg, SegmentedStore)
+    assert not isinstance(open_store(str(tmp_path / "s.json")),
+                          SegmentedStore)
+
+
+def test_roundtrip_parity_with_monolithic(tmp_path, spmv):
+    """Same adds into both backends -> identical record sets, identical
+    best answers: the resolver cannot tell them apart except by speed."""
+    _, fps, seqs = spmv
+    mono = ScheduleStore(str(tmp_path / "mono.json"), tenant="t")
+    seg = SegmentedStore(str(tmp_path / "seg"), tenant="t")
+    for s in (mono, seg):
+        s.add(fps["a"], seqs[1], pct50_us=10.0, vs_naive=2.0,
+              verified=True)
+        s.add(fps["a"], seqs[2], pct50_us=11.0, vs_naive=1.8)
+        s.add(fps["b"], seqs[3], pct50_us=9.0, vs_naive=2.2)
+        s.flush()
+    seg2 = SegmentedStore(str(tmp_path / "seg"))
+    mono2 = ScheduleStore(str(tmp_path / "mono.json"))
+    assert _records_doc(seg2) == _records_doc(mono2)
+    assert seg2.best(fps["a"].exact_digest)["vs_naive"] == 2.0
+    assert seg2.best(fps["a"].exact_digest)["verified_at_admission"] is True
+
+
+def test_flush_cost_is_dirty_records_not_corpus(tmp_path, spmv):
+    """The tentpole economics: a flush writes one segment per DIRTY
+    bucket containing only the dirty records — corpus size never
+    re-serializes."""
+    _, fps, seqs = spmv
+    s = SegmentedStore(str(tmp_path / "seg"))
+    s.add(fps["a"], seqs[1], pct50_us=10.0, vs_naive=2.0)
+    s.add(fps["a"], seqs[2], pct50_us=11.0, vs_naive=1.8)
+    s.flush()
+    assert len(_seg_files(s.dir)) == 1
+    # no dirt -> no new segment
+    s.flush()
+    assert len(_seg_files(s.dir)) == 1
+    # one new record -> exactly one new single-record segment
+    s.add(fps["a"], seqs[3], pct50_us=9.0, vs_naive=2.5)
+    s.flush()
+    files = _seg_files(s.dir)
+    assert len(files) == 2
+    new = sorted(files)[-1]
+    with open(os.path.join(s.dir, "segments", new)) as f:
+        header = json.loads(f.readline())
+    assert header["n_records"] == 1
+    # the full corpus survives on reload (distinct by schedule key:
+    # two of the driven sequences may canonicalize to one slot)
+    from tenzing_tpu.serve.fingerprint import schedule_key
+
+    distinct = len({schedule_key(q) for q in (seqs[1], seqs[2], seqs[3])})
+    assert len(SegmentedStore(s.dir)) == distinct
+
+
+def test_two_writers_concurrent_flush(tmp_path, spmv):
+    """Two stores flushing simultaneously: the manifest read-modify-write
+    serializes under the flock+backoff, and segments are per-writer
+    files — both land, nothing is lost."""
+    _, fps, seqs = spmv
+    path = str(tmp_path / "seg")
+    a = SegmentedStore(path, tenant="w-a")
+    b = SegmentedStore(path, tenant="w-b")
+    a.add(fps["a"], seqs[1], pct50_us=10.0, vs_naive=2.0)
+    b.add(fps["b"], seqs[2], pct50_us=12.0, vs_naive=1.5)
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def go(store):
+        try:
+            barrier.wait()
+            store.flush()
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    ts = [threading.Thread(target=go, args=(s,)) for s in (a, b)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    merged = SegmentedStore(path)
+    assert len(merged) == 2
+    assert merged.orphan_segments == []  # both flushes indexed
+
+
+# -- corruption paths --------------------------------------------------------
+
+def _warmed(tmp_path, spmv, name="seg"):
+    _, fps, seqs = spmv
+    s = SegmentedStore(str(tmp_path / name))
+    s.add(fps["a"], seqs[1], pct50_us=10.0, vs_naive=2.0, verified=True)
+    s.add(fps["a"], seqs[2], pct50_us=11.0, vs_naive=1.8, verified=True)
+    s.add(fps["b"], seqs[3], pct50_us=9.0, vs_naive=2.2, verified=True)
+    s.flush()
+    return s
+
+
+def test_truncated_segment_salvages_prefix_and_quarantines(tmp_path, spmv):
+    s = _warmed(tmp_path, spmv)
+    (name,) = _seg_files(s.dir)
+    path = os.path.join(s.dir, "segments", name)
+    text = open(path).read()
+    # cut mid-way through the LAST record line: a torn append
+    open(path, "w").write(text[:int(len(text) * 0.8)])
+    notes = []
+    loaded = SegmentedStore(s.dir, log=notes.append)
+    assert len(loaded) == 2  # the checksum-valid prefix survives
+    assert loaded.salvaged == 2
+    assert loaded.quarantined_segments == [name]
+    corpses = [n for n in os.listdir(os.path.join(s.dir, "segments"))
+               if ".corrupt-" in n]
+    assert len(corpses) == 1
+    assert any("quarantined damaged segment" in n for n in notes)
+    # salvage is re-persisted by the next flush: a fresh load needs no
+    # damaged file to see the records
+    loaded.flush()
+    final = SegmentedStore(s.dir)
+    assert len(final) == 2 and final.quarantined_segments == []
+
+
+def test_bitflipped_record_checksum_catches_it(tmp_path, spmv):
+    s = _warmed(tmp_path, spmv)
+    (name,) = _seg_files(s.dir)
+    path = os.path.join(s.dir, "segments", name)
+    lines = open(path).read().splitlines()
+    # flip one byte inside the middle record's payload (line stays JSON:
+    # we alter a digit of pct50_us, the checksum must catch it)
+    assert '"pct50_us": 11.0' in lines[2] or '"pct50_us":11.0' in lines[2]
+    lines[2] = lines[2].replace("11.0", "71.0", 1)
+    open(path, "w").write("\n".join(lines) + "\n")
+    loaded = SegmentedStore(s.dir, log=lambda m: None)
+    assert loaded.checksum_failed == 1
+    assert len(loaded) == 2  # the flipped record is dropped, rest served
+    assert loaded.quarantined_segments == [name]  # rot never lingers
+
+
+def test_torn_manifest_recovers_by_scan(tmp_path, spmv):
+    s = _warmed(tmp_path, spmv)
+    man = os.path.join(s.dir, "manifest.json")
+    open(man, "w").write('{"version": 1, "segments": {tor')
+    notes = []
+    loaded = SegmentedStore(s.dir, log=notes.append)
+    assert len(loaded) == 3  # the scan is ground truth: zero loss
+    assert not os.path.exists(man)  # quarantined aside
+    assert [n for n in os.listdir(s.dir) if "manifest.json.corrupt-" in n]
+    assert any("recovering from segment scan" in n for n in notes)
+    # the segments are now orphans; a compaction adopts them back
+    summary = Compactor(s.dir, log=lambda m: None).run()
+    assert summary["orphans_adopted"] + summary["buckets_compacted"] > 0
+    again = SegmentedStore(s.dir)
+    assert len(again) == 3 and again.orphan_segments == []
+
+
+def test_readonly_load_reports_damage_without_touching(tmp_path, spmv):
+    """The report CLI's contract: quarantine_corrupt=False must leave a
+    damaged tree byte-for-byte intact while still reporting records."""
+    s = _warmed(tmp_path, spmv)
+    (name,) = _seg_files(s.dir)
+    seg_path = os.path.join(s.dir, "segments", name)
+    text = open(seg_path).read()
+    open(seg_path, "w").write(text[:int(len(text) * 0.8)])
+    open(os.path.join(s.dir, "manifest.json"), "w").write("{torn")
+
+    def tree(d):
+        out = {}
+        for root, _, files in os.walk(d):
+            for f in files:
+                p = os.path.join(root, f)
+                out[os.path.relpath(p, d)] = hashlib.sha256(
+                    open(p, "rb").read()).hexdigest()
+        return out
+
+    before = tree(s.dir)
+    notes = []
+    ro = SegmentedStore(s.dir, log=notes.append, quarantine_corrupt=False)
+    assert len(ro) == 2  # salvage in memory only
+    assert tree(s.dir) == before  # NOTHING renamed, created, or rewritten
+    # ...and the actual report CLI section stays read-only too
+    from tenzing_tpu.obs.report import store_section
+
+    lines = store_section([s.dir])
+    assert tree(s.dir) == before
+    assert any("segments" in ln for ln in lines)
+
+
+# -- compaction --------------------------------------------------------------
+
+def test_compactor_merges_reclaims_and_ledgers(tmp_path, spmv):
+    _, fps, seqs = spmv
+    s = SegmentedStore(str(tmp_path / "seg"))
+    for i, (fp, seq, pct, vs) in enumerate([
+            (fps["a"], seqs[1], 10.0, 2.0),
+            (fps["a"], seqs[2], 11.0, 1.8),
+            (fps["b"], seqs[3], 9.0, 2.2)]):
+        s.add(fp, seq, pct50_us=pct, vs_naive=vs)
+        s.flush()  # one segment per flush: a multi-segment bucket
+    assert len(_seg_files(s.dir)) == 3
+    before = _records_doc(SegmentedStore(s.dir))
+    summary = Compactor(s.dir, log=lambda m: None).run()
+    assert summary["buckets_compacted"] == 1  # a+b share one bucket
+    assert summary["segments_reclaimed"] == 3
+    assert summary["skipped"] is None
+    files = _seg_files(s.dir)
+    assert len(files) == 1
+    after = SegmentedStore(s.dir)
+    assert _records_doc(after) == before  # byte-identical record set
+    ledger = after.manifest_doc["compactions"]
+    assert ledger and ledger[-1]["output"] == files[0]
+    assert len(ledger[-1]["inputs"]) == 3
+
+
+def test_compactor_lease_excludes_rivals(tmp_path, spmv):
+    s = _warmed(tmp_path, spmv)
+    rival = LeaseFile(os.path.join(s.dir, "compact.lease"), "rival",
+                      ttl_secs=300.0)
+    assert rival.claim() is not None
+    summary = Compactor(s.dir, log=lambda m: None).run()
+    assert summary["skipped"] == "lease-held"
+    assert summary["buckets_compacted"] == 0
+    rival.release()
+    assert Compactor(s.dir, log=lambda m: None).run()["skipped"] is None
+
+
+def _compact_cli(store_dir, *extra):
+    return subprocess.run(
+        [sys.executable, "-m", "tenzing_tpu.serve", "compact",
+         "--store", store_dir, "--lease-ttl", "0.2", *extra],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+@pytest.mark.parametrize("window", ["segment", "manifest"])
+def test_mid_compaction_sigkill_recovers_to_superset(tmp_path, spmv,
+                                                     window):
+    """kill -9 in either publish window (after the merged segment,
+    before the manifest; after the manifest, before reclaim): every
+    pre-kill record survives with a valid checksum, and the next
+    compaction converges the tree."""
+    _, fps, seqs = spmv
+    s = SegmentedStore(str(tmp_path / f"seg-{window}"))
+    for fp, seq, pct, vs in [(fps["a"], seqs[1], 10.0, 2.0),
+                             (fps["a"], seqs[2], 11.0, 1.8),
+                             (fps["c"], seqs[3], 9.0, 2.2)]:
+        s.add(fp, seq, pct50_us=pct, vs_naive=vs)
+        s.flush()
+    before = _records_doc(SegmentedStore(s.dir))
+    r = _compact_cli(s.dir, "--crash-after", window)
+    assert r.returncode == -9, (r.returncode, r.stderr[-500:])
+    # recovery: a plain load sees a SUPERSET (here: exactly the pre-kill
+    # records — duplicates merge idempotently), all checksums valid
+    notes = []
+    crashed = SegmentedStore(s.dir, log=notes.append)
+    assert _records_doc(crashed) == before
+    assert crashed.checksum_failed == 0
+    assert crashed.quarantined_segments == []
+    # the killed compactor's lease is left behind; a successor reclaims
+    # it (TTL-expired) and finishes the job
+    import time
+
+    time.sleep(0.25)
+    r2 = _compact_cli(s.dir)
+    assert r2.returncode == 0, r2.stderr[-500:]
+    final = SegmentedStore(s.dir)
+    assert _records_doc(final) == before
+    assert final.orphan_segments == []
+    # converged: one segment per bucket
+    buckets = {segment_bucket_of(n) for n in _seg_files(s.dir)}
+    assert len(_seg_files(s.dir)) == len(buckets) == 2
+
+
+def test_compactor_never_reclaims_unseen_rival_segment(tmp_path, spmv,
+                                                       monkeypatch):
+    """A segment published by a live writer AFTER the compactor loaded
+    the store must survive the pass with its record intact: the merge
+    and reclaim sets are the LOADED segments, never a fresh disk scan
+    (a rescan would unlink the rival's segment without its records ever
+    entering the merged output — permanent loss, not a superset)."""
+    import tenzing_tpu.serve.segments as segments
+
+    _, fps, seqs = spmv
+    path = str(tmp_path / "seg")
+    s = SegmentedStore(path)
+    s.add(fps["a"], seqs[1], pct50_us=10.0, vs_naive=2.0)
+    s.flush()
+    s.add(fps["a"], seqs[2], pct50_us=11.0, vs_naive=1.8)
+    s.flush()
+    # emulate the race deterministically: hook the compactor-store's
+    # flush (the first thing run() does after its load) to let a rival
+    # land a same-bucket segment inside the window
+    real_flush = segments.SegmentedStore.flush
+    fired = {}
+
+    def flush_with_rival(self):
+        if not fired and self.tenant == "compactor":
+            fired["x"] = True
+            rival = SegmentedStore(path, tenant="rival")
+            rival.add(fps["b"], seqs[3], pct50_us=9.0, vs_naive=2.2)
+            real_flush(rival)
+        return real_flush(self)
+
+    monkeypatch.setattr(segments.SegmentedStore, "flush",
+                        flush_with_rival)
+    summary = Compactor(path, log=lambda m: None).run()
+    assert summary["buckets_compacted"] == 1
+    final = SegmentedStore(path)
+    assert final.best(fps["b"].exact_digest) is not None, \
+        "rival's mid-pass record was reclaimed without being merged"
+    assert final.best(fps["a"].exact_digest)["vs_naive"] == 2.0
+
+
+# -- merge algebra of the admission stamp ------------------------------------
+
+def test_admission_stamp_merges_sticky_both_orders():
+    base = {"schema": RECORD_SCHEMA, "exact": "e", "bucket": "b",
+            "key": "k", "ops": [], "workload": "spmv", "vs_naive": 2.0,
+            "pct50_us": 10.0, "sources": [], "flags": {}}
+    stamped = dict(base, verified_at_admission=True)
+    for m in (merge_records(stamped, dict(base)),
+              merge_records(dict(base), stamped)):
+        assert m["verified_at_admission"] is True
+    plain = merge_records(dict(base), dict(base))
+    assert "verified_at_admission" not in plain
+
+
+def test_record_digest_canonical():
+    a = {"x": 1, "y": [1, 2]}
+    assert record_digest({"y": [1, 2], "x": 1}) == record_digest(a)
+    assert record_digest({"x": 2, "y": [1, 2]}) != record_digest(a)
+
+
+# -- the shared lease protocol ----------------------------------------------
+
+def test_lease_file_protocol(tmp_path):
+    path = str(tmp_path / "x.lease")
+    a = LeaseFile(path, "a", ttl_secs=300.0)
+    b = LeaseFile(path, "b", ttl_secs=300.0)
+    info = a.claim()
+    assert info is not None and info.reclaimed is False
+    assert b.claim() is None  # live rival
+    assert a.owns() and a.renew()
+    # expire it: b reclaims, a's renew detects the loss by nonce
+    past = os.path.getmtime(path) - 1000
+    os.utime(path, (past, past))
+    info_b = b.claim()
+    assert info_b is not None and info_b.reclaimed is True
+    assert info_b.prev_owner == "a"
+    assert a.renew() is False
+    # a's release must not delete b's live lease
+    a.release()
+    assert os.path.exists(path) and b.owns()
+    assert b.release() is True
+    assert not os.path.exists(path)
