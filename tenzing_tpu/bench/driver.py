@@ -591,11 +591,28 @@ def run(req: DriverRequest) -> DriverResult:
         # silently ignoring resume would re-measure a multi-hour search
         # from scratch while the output JSON claims a resume happened
         raise DriverConfigError("--resume requires --checkpoint DIR")
+    # adopt a parent process's trace context (obs/context.py): a drain
+    # child spawned by the daemon — or a bare bench.py run under
+    # TENZING_TRACE_CONTEXT — stamps every span/event with the
+    # originating query's trace_id, so its bundle stitches into the
+    # fleet trace.  Installed as the process default (worker threads —
+    # the prefetch pool — inherit it) and restored on return: run() is
+    # called in a loop by in-process drainers.
+    from tenzing_tpu.obs import context as _obs_context
+
+    env_ctx = None
+    prev_ctx = None
+    if _obs_context.current() is None:
+        env_ctx = _obs_context.from_env()
+        if env_ctx is not None:
+            prev_ctx = _obs_context.set_process_default(env_ctx)
     scope = _RunScope()
     try:
         return _run(args, scope)
     finally:
         scope.close()
+        if env_ctx is not None:
+            _obs_context.set_process_default(prev_ctx)
 
 
 def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:

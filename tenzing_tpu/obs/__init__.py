@@ -5,21 +5,30 @@ The observability layer the whole decision loop reports through (ISSUE 1):
 * :mod:`tenzing_tpu.obs.tracer` — nested spans + instant events, thread-safe,
   near-zero overhead when disabled; every record is tagged with the control
   plane's rank so multi-host traces merge in one timeline.
+* :mod:`tenzing_tpu.obs.context` — the cross-process trace context
+  (``trace_id`` minted at serving ingress, carried through work-item
+  envelopes and subprocess environments): while one is ambient, every
+  span/event is stamped with it, so fleet bundles stitch per request.
 * :mod:`tenzing_tpu.obs.metrics` — counters / gauges / histograms with
-  percentile summaries; subsumes ``utils/counters.py`` (kept as a shim).
+  percentile summaries; subsumes ``utils/counters.py`` (kept as a shim);
+  plus the streaming metric-snapshot exporter long-lived serve processes
+  publish their live state through.
 * :mod:`tenzing_tpu.obs.progress` — human-readable progress lines that also
   flow into the tracer's event stream, replacing raw ``print()`` in library
   code (enforced by tests/test_no_print.py).
 * :mod:`tenzing_tpu.obs.export` — JSONL (machine consumption) and Chrome
-  trace-event JSON (load in Perfetto / chrome://tracing) sinks.
+  trace-event JSON (load in Perfetto / chrome://tracing) sinks, and the
+  cross-process trace stitcher (``python -m tenzing_tpu.obs.export``).
 
 Everything here is stdlib-only so any module in the package can import it
 without cycles.  See docs/observability.md for the end-to-end workflow.
 """
 
+from tenzing_tpu.obs.context import TraceContext, new_trace
 from tenzing_tpu.obs.export import (
     chrome_trace,
     read_jsonl,
+    stitch,
     to_jsonl,
     write_chrome_trace,
     write_jsonl,
@@ -29,7 +38,10 @@ from tenzing_tpu.obs.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    MetricsSnapshotWriter,
+    SloConfig,
     get_metrics,
+    latest_snapshots,
     set_metrics,
 )
 from tenzing_tpu.obs.progress import ProgressReporter, get_reporter, set_reporter
@@ -41,18 +53,24 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "MetricsSnapshotWriter",
     "ProgressReporter",
+    "SloConfig",
     "Span",
+    "TraceContext",
     "Tracer",
     "chrome_trace",
     "configure",
     "get_metrics",
     "get_reporter",
     "get_tracer",
+    "latest_snapshots",
+    "new_trace",
     "read_jsonl",
     "set_metrics",
     "set_reporter",
     "set_tracer",
+    "stitch",
     "to_jsonl",
     "write_chrome_trace",
     "write_jsonl",

@@ -1,0 +1,169 @@
+"""Cross-process trace context: one ``trace_id`` follows a request
+through the whole serving fleet.
+
+The PR-1 tracer is strictly per-process: a ``serve listen`` query, the
+cold work item it enqueues, the drain daemon that claims it, the
+daemon's subprocess drain, and the store merge that finally answers the
+re-query each record spans into their own bundle, unlinkable from each
+other.  This module is the link (docs/observability.md "Fleet telemetry
+plane"): a tiny immutable :class:`TraceContext` — ``trace_id`` plus the
+minting side's ``span_id`` — is
+
+* **minted at ingress** (``serve listen`` per request; the resolver
+  mints one itself when a caller arrives without one, so the one-shot
+  ``serve query`` CLI participates identically);
+* **made ambient** with :func:`use` (a thread-local stack with a
+  process-global fallback, :func:`set_process_default`, for processes
+  whose whole lifetime serves one request — a daemon's drain child);
+* **stamped automatically** onto every span and event the tracer
+  records while a context is ambient (``trace_id`` / ``parent_span``
+  attrs — obs/tracer.py consults :func:`current_trace_attrs`);
+* **carried across process boundaries** two ways, deliberately
+  redundant: the :data:`TRACE_ENV` environment variable (cheap, works
+  for any child) and the work item's checkpoint envelope (the
+  ``trace`` key serve/store.py ``WorkQueue`` stamps) — the envelope is
+  the SIGKILL-survivable copy: a successor daemon reclaiming a dead
+  worker's lease re-reads the item from disk and resumes the drain
+  under the *same* trace_id, no live parent required.
+
+Everything here is stdlib-only and imports nothing from the rest of
+``obs`` (the tracer imports *us*, not vice versa — no cycle).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, Optional
+
+# the environment variable a parent sets for its children ("trace_id:span_id")
+TRACE_ENV = "TENZING_TRACE_CONTEXT"
+
+
+def _mint_id(nbytes: int = 8) -> str:
+    """A random hex id (default 16 hex chars) — ``os.urandom``, not
+    ``random``: context minting must never perturb (or depend on) the
+    seeded RNG streams the solvers replay deterministically."""
+    return os.urandom(nbytes).hex()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One request's identity: ``trace_id`` names the whole journey,
+    ``span_id`` the hop that handed it to us (the remote parent)."""
+
+    trace_id: str
+    span_id: str
+
+    def child(self) -> "TraceContext":
+        """The context to hand DOWNSTREAM: same trace, fresh hop id."""
+        return TraceContext(self.trace_id, _mint_id())
+
+    def to_json(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def to_env_value(self) -> str:
+        return f"{self.trace_id}:{self.span_id}"
+
+
+def new_trace() -> TraceContext:
+    """Mint a fresh context — THE ingress call (one per request)."""
+    return TraceContext(_mint_id(), _mint_id())
+
+
+def from_json(doc: Any) -> Optional[TraceContext]:
+    """A context from its envelope form; None for anything malformed —
+    a torn ``trace`` key must never fail the drain it rides with."""
+    if not isinstance(doc, dict):
+        return None
+    tid, sid = doc.get("trace_id"), doc.get("span_id")
+    if not (isinstance(tid, str) and tid):
+        return None
+    return TraceContext(tid, sid if isinstance(sid, str) and sid else "0")
+
+
+def from_env(environ: Optional[Dict[str, str]] = None) -> Optional[TraceContext]:
+    """The context a parent process exported via :data:`TRACE_ENV`."""
+    raw = (environ if environ is not None else os.environ).get(TRACE_ENV)
+    if not raw:
+        return None
+    tid, _, sid = raw.partition(":")
+    if not tid:
+        return None
+    return TraceContext(tid, sid or "0")
+
+
+def to_env(environ: Dict[str, str], ctx: Optional[TraceContext]) -> Dict[str, str]:
+    """Stamp ``ctx`` into an environment mapping (for a child process);
+    a None context leaves the mapping untouched."""
+    if ctx is not None:
+        environ[TRACE_ENV] = ctx.to_env_value()
+    return environ
+
+
+# -- ambient context --------------------------------------------------------
+
+_local = threading.local()
+_process_default: Optional[TraceContext] = None
+_default_lock = threading.Lock()
+
+
+def current() -> Optional[TraceContext]:
+    """The ambient context: this thread's innermost :func:`use`, else
+    the process default (set by a drain child adopting its parent's
+    envelope — worker threads inherit it without any threading of
+    arguments)."""
+    stack = getattr(_local, "stack", None)
+    if stack:
+        return stack[-1]
+    return _process_default
+
+
+class _Use:
+    """Re-entrant-friendly context manager pushing one context onto the
+    thread-local stack; ``use(None)`` is a no-op (callers never need a
+    conditional ``with``)."""
+
+    __slots__ = ("ctx", "_pushed")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self.ctx = ctx
+        self._pushed = False
+
+    def __enter__(self) -> Optional[TraceContext]:
+        if self.ctx is not None:
+            stack = getattr(_local, "stack", None)
+            if stack is None:
+                stack = _local.stack = []
+            stack.append(self.ctx)
+            self._pushed = True
+        return self.ctx
+
+    def __exit__(self, *exc) -> None:
+        if self._pushed:
+            _local.stack.pop()
+
+
+def use(ctx: Optional[TraceContext]) -> _Use:
+    """``with use(ctx): ...`` — make ``ctx`` ambient on this thread."""
+    return _Use(ctx)
+
+
+def set_process_default(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Set (or clear, with None) the process-wide fallback context;
+    returns the previous default so a scoped caller can restore it."""
+    global _process_default
+    with _default_lock:
+        prev, _process_default = _process_default, ctx
+    return prev
+
+
+def current_trace_attrs() -> Optional[Dict[str, str]]:
+    """What the tracer stamps onto a record while a context is ambient
+    (obs/tracer.py) — None (the common case) costs one thread-local
+    probe and one global read."""
+    ctx = current()
+    if ctx is None:
+        return None
+    return {"trace_id": ctx.trace_id, "parent_span": ctx.span_id}

@@ -11,14 +11,28 @@ Histogram summaries use the same nearest-rank percentile convention as
 ``BenchResult`` (utils/numeric.py::percentile — a stdlib-only module, so the
 import stays cycle-free) and retain raw observations up to a cap so archived
 metrics can be re-derived offline without hot loops growing memory unbounded.
+
+The **streaming exporter** (:class:`MetricsSnapshotWriter`) is the
+fleet-facing half (docs/observability.md "Fleet telemetry plane"): a
+long-lived process (``serve listen``, the drain daemon) periodically
+writes an atomic **metric-snapshot document** into a bounded ring of
+files next to its ``status-<owner>.json`` — the whole registry
+serialized non-blocking, the tracer's retention/drop tallies, and an
+**SLO block** (:class:`SloConfig`: the exact-tier pct99 vs a configured
+target and vs the committed ``SERVE_BENCH`` baseline, with the burn
+direction).  ``obs/report.py --follow`` tails these documents.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, List
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 from tenzing_tpu.utils.numeric import percentile
 
@@ -68,20 +82,31 @@ class Histogram:
     memory without bound.  A truncated summary carries ``raw_retained`` and
     ``truncated: true`` so downstream tooling (e.g. the report CLI,
     obs/report.py) labels the percentiles prefix-only instead of silently
-    treating them as full-series statistics."""
+    treating them as full-series statistics.
+
+    ``window=True`` retains the most RECENT ``max_raw`` observations
+    instead of the first (a deque ring): the serving-latency series a
+    live SLO block reads must reflect current traffic — first-N
+    retention would freeze the pct99 at whatever the process saw before
+    the cap filled, hiding every regression after warm-up.  Windowed
+    summaries carry ``window: true`` (+ ``raw_retained``) instead of
+    ``truncated``."""
 
     __slots__ = ("name", "_lock", "_values", "_count", "_sum", "_min",
-                 "_max", "_max_raw")
+                 "_max", "_max_raw", "_window")
 
-    def __init__(self, name: str, max_raw: int = 65536):
+    def __init__(self, name: str, max_raw: int = 65536,
+                 window: bool = False):
         self.name = name
         self._lock = threading.Lock()
-        self._values: List[float] = []
+        self._window = bool(window)
+        self._max_raw = max(1, max_raw)
+        self._values = (deque(maxlen=self._max_raw) if self._window
+                        else [])  # type: ignore[var-annotated]
         self._count = 0
         self._sum = 0.0
         self._min = float("inf")
         self._max = float("-inf")
-        self._max_raw = max(1, max_raw)
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -92,8 +117,8 @@ class Histogram:
                 self._min = value
             if value > self._max:
                 self._max = value
-            if len(self._values) < self._max_raw:
-                self._values.append(value)
+            if self._window or len(self._values) < self._max_raw:
+                self._values.append(value)  # deque evicts oldest itself
 
     @property
     def count(self) -> int:
@@ -140,13 +165,18 @@ class Histogram:
             "p99": percentile(xs, 99),
         }
         if len(xs) < count:
-            # the retained-raw cap truncated the series: the percentiles
-            # cover only the first ``raw_retained`` of ``count``
-            # observations.  ``truncated`` is the explicit marker readers
-            # (the report CLI labels such percentiles "prefix-only") can
-            # key on without comparing count vs raw_retained themselves.
+            # the retained-raw cap bounded the series: the percentiles
+            # cover only ``raw_retained`` of ``count`` observations —
+            # the FIRST raw_retained for plain histograms (``truncated``,
+            # labeled "prefix-only" by the report CLI) or the most
+            # RECENT for windowed ones (``window``, labeled
+            # "recent-window").  Explicit markers so readers never have
+            # to compare count vs raw_retained themselves.
             out["raw_retained"] = len(xs)
-            out["truncated"] = True
+            if self._window:
+                out["window"] = True
+            else:
+                out["truncated"] = True
         return out
 
 
@@ -173,11 +203,20 @@ class MetricsRegistry:
                 inst = self._gauges[name] = Gauge(name)
             return inst
 
-    def histogram(self, name: str) -> Histogram:
+    def histogram(self, name: str, max_raw: Optional[int] = None,
+                  window: bool = False) -> Histogram:
+        """Get-or-create; ``max_raw`` / ``window`` shape the raw-series
+        retention and apply only at creation (the first caller of a
+        name decides — a long-lived serve loop passes a small windowed
+        cap for its latency series so live percentiles track current
+        traffic, docs/observability.md)."""
         with self._lock:
             inst = self._histograms.get(name)
             if inst is None:
-                inst = self._histograms[name] = Histogram(name)
+                kwargs: Dict[str, Any] = {"window": window}
+                if max_raw is not None:
+                    kwargs["max_raw"] = max_raw
+                inst = self._histograms[name] = Histogram(name, **kwargs)
             return inst
 
     def histograms(self) -> Dict[str, Histogram]:
@@ -237,3 +276,164 @@ def set_metrics(registry: MetricsRegistry) -> MetricsRegistry:
     global _GLOBAL
     prev, _GLOBAL = _GLOBAL, registry
     return prev
+
+
+# -- streaming snapshot exporter (the fleet telemetry plane) ----------------
+
+SNAPSHOT_VERSION = 1
+
+
+@dataclass
+class SloConfig:
+    """What "healthy" means for one latency series (module docstring).
+
+    ``target_us`` is the operator's objective (the ROADMAP's
+    tens-of-µs exact-tier goal); ``baseline_pct99_us`` anchors the burn
+    direction — normally read from the committed ``SERVE_BENCH_r*.json``
+    family via :func:`baseline_pct99_from`."""
+
+    target_us: Optional[float] = None
+    baseline_pct99_us: Optional[float] = None
+    histogram: str = "serve.resolve_us.exact"
+    # beyond this relative drift from the baseline the burn direction
+    # stops reading "flat" — the same 5% the regression gate defaults to
+    drift_tol: float = 0.05
+
+    def block(self, registry: MetricsRegistry) -> Dict[str, Any]:
+        """The SLO block one snapshot carries: current pct99 of the
+        configured histogram vs target and baseline."""
+        hist = registry.histograms().get(self.histogram)
+        summary = hist.summary(block=False) if hist is not None else {}
+        pct99 = summary.get("p99")
+        out: Dict[str, Any] = {
+            "histogram": self.histogram,
+            "count": summary.get("count", 0),
+            "pct99_us": pct99,
+            "target_us": self.target_us,
+            "baseline_pct99_us": self.baseline_pct99_us,
+        }
+        if pct99 is not None and self.target_us:
+            out["within_target"] = bool(pct99 <= self.target_us)
+        if pct99 is not None and self.baseline_pct99_us:
+            ratio = pct99 / self.baseline_pct99_us
+            out["vs_baseline"] = round(ratio, 4)
+            out["burn"] = ("improving" if ratio < 1.0 - self.drift_tol
+                           else "degrading" if ratio > 1.0 + self.drift_tol
+                           else "flat")
+        return out
+
+
+def baseline_pct99_from(path: str) -> Optional[float]:
+    """The exact-tier pct99 of a committed serve-replay baseline
+    (``SERVE_BENCH_r*.json`` — serve/replay.py result document); None
+    when the file is unreadable or not of that family."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        exact = (doc.get("segmented") or {}).get("resolve_us", {}).get(
+            "exact") or {}
+        v = exact.get("pct99_us")
+        return float(v) if v is not None else None
+    except (OSError, ValueError, TypeError, AttributeError):
+        return None
+
+
+class MetricsSnapshotWriter:
+    """Periodic atomic metric-snapshot documents, bounded ring per owner.
+
+    Files are ``metrics-<owner>-<k>.json`` with ``k = seq % ring`` —
+    the on-disk footprint of a process that snapshots every heartbeat
+    for a month is ``ring`` files, not a month of files; each document
+    carries its monotonic ``seq`` so readers (:func:`latest_snapshots`,
+    the report CLI's ``--follow``) order them without trusting mtimes.
+    Writes go through utils/atomic.py (fsync + rename) and every read
+    in the document is non-blocking — the writer is safe to call from a
+    heartbeat thread and from signal-trap paths alike."""
+
+    def __init__(self, directory: str, owner: str, ring: int = 8,
+                 slo: Optional[SloConfig] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer=None):
+        self.dir = directory
+        self.owner = owner
+        self.ring = max(1, int(ring))
+        self.slo = slo
+        self._registry = registry
+        self._tracer = tracer
+        self.seq = 0
+
+    def path_for(self, seq: int) -> str:
+        return os.path.join(
+            self.dir, f"metrics-{self.owner}-{seq % self.ring}.json")
+
+    def build(self, state: str = "serving",
+              extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """The snapshot document (without writing it) — also the
+        ``metrics`` verb's response body on the listen protocol."""
+        from tenzing_tpu.obs.tracer import get_tracer
+
+        registry = self._registry if self._registry is not None \
+            else get_metrics()
+        tracer = self._tracer if self._tracer is not None else get_tracer()
+        doc: Dict[str, Any] = {
+            "version": SNAPSHOT_VERSION,
+            "kind": "metrics_snapshot",
+            "owner": self.owner,
+            "seq": self.seq,
+            "written_at": time.time(),
+            "state": state,
+            "metrics": registry.to_json(block=False),
+            "tracer": tracer.retention(),
+        }
+        if self.slo is not None:
+            doc["slo"] = self.slo.block(registry)
+        if extra:
+            doc.update(extra)
+        return doc
+
+    def write(self, state: str = "serving",
+              extra: Optional[Dict[str, Any]] = None) -> str:
+        from tenzing_tpu.utils.atomic import atomic_dump_json
+
+        doc = self.build(state=state, extra=extra)
+        path = self.path_for(self.seq)
+        os.makedirs(self.dir, exist_ok=True)
+        atomic_dump_json(path, doc, prefix=".metrics.")
+        self.seq += 1
+        return path
+
+
+def latest_snapshots(directory: str) -> Dict[str, Dict[str, Any]]:
+    """The newest snapshot document per owner found in ``directory``
+    (max ``(written_at, seq)`` wins).  Wall-clock first, seq as the
+    tiebreak: a restarted process starts over at seq 0 while the dead
+    incarnation's high-seq documents still occupy the other ring slots
+    — ordering by seq alone would show the dead process's state for up
+    to ring-1 heartbeats.  Unreadable/foreign files are skipped: the
+    follow view must render whatever half-written fleet state exists."""
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def key(doc):
+        try:
+            at = float(doc.get("written_at", 0))
+        except (TypeError, ValueError):
+            at = 0.0
+        return (at, doc.get("seq", -1))
+
+    if not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("metrics-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("kind") != "metrics_snapshot":
+            continue
+        owner = doc.get("owner", "?")
+        prev = out.get(owner)
+        if prev is None or key(doc) > key(prev):
+            out[owner] = doc
+    return out

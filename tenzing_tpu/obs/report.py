@@ -38,6 +38,20 @@ carries the winner's raw measurement series and that series fails the
 i.i.d. test, a would-be regression is reported ``inconclusive`` (drift or
 interference — re-measure) instead of flagged.  Exit status: 0 ok /
 inconclusive, 1 regression, 2 usage error.
+
+The same flags accept the **SERVE_BENCH family** (serve/replay.py
+trace-replay documents, ``kind: "serve_trace_replay"``): the primary
+series becomes the segmented exact-tier ``pct99_us`` (a ceiling —
+higher is a regression), the secondaries are per-query verifier calls
+and shed count reappearing, and the noise rule runs the same runs test
+over the document's raw ``exact_samples_us`` series — a serve-replay
+pct99 regression fails the build exactly like a bench one.
+
+``--follow`` is the live fleet view (docs/observability.md "Fleet
+telemetry plane"): tail the ``status-*.json`` and ``metrics-*.json``
+documents of every serve loop and drain daemon under ``--store`` /
+``--queue-dir``, rendering liveness, queue depth/age, tier hit mix,
+and SLO state every ``--interval`` seconds.
 """
 
 from __future__ import annotations
@@ -47,6 +61,7 @@ import glob as _glob
 import json
 import os
 import sys
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -79,6 +94,21 @@ def load_driver_json(path: str) -> Dict[str, Any]:
         if isinstance(doc, dict) and "metric" in doc:
             return doc
     raise ValueError(f"{path}: no driver JSON found")
+
+
+def _load_check_doc(path: str) -> Dict[str, Any]:
+    """A document for the regression check: a SERVE_BENCH trace-replay
+    result (``kind: "serve_trace_replay"``) is returned whole; anything
+    else goes through the driver-JSON loader."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and \
+                doc.get("kind") == "serve_trace_replay":
+            return doc
+    except ValueError:
+        pass
+    return load_driver_json(path)
 
 
 # -- regression check -------------------------------------------------------
@@ -120,22 +150,82 @@ def check_regression(fresh: Dict[str, Any], baseline: Dict[str, Any],
 
     verdict = "regression" if reasons else "ok"
     times = (fresh.get("attrib") or {}).get("measured_times")
-    if reasons and times and len(times) >= 8:
+    verdict, checks2 = _noise_downgrade(verdict, reasons, times)
+    checks.update(checks2)
+    return {"verdict": verdict, "tol": tol, "reasons": reasons,
+            "checks": checks}
+
+
+def _noise_downgrade(verdict: str, reasons: List[str],
+                     times) -> Tuple[str, Dict[str, Any]]:
+    """THE shared noise rule: a would-be regression whose fresh raw
+    series fails bench/randomness.py's i.i.d. runs test downgrades to
+    ``inconclusive`` — the measurement, not the code, is suspect.  Used
+    by both the driver-verdict and the serve-replay check."""
+    checks: Dict[str, Any] = {}
+    if verdict == "regression" and times and len(times) >= 8:
         from tenzing_tpu.bench.randomness import runs_test_z
 
         z_crit = 1.96  # is_random's 95%-confidence default
-        z = runs_test_z(times)
+        z = runs_test_z(list(times))
         checks["runs_test_z"] = round(z, 3)
         if abs(z) > z_crit:
-            # the fresh series shows non-random structure (drift /
-            # interference): the measurement, not the schedule, is suspect
             verdict = "inconclusive"
             reasons.append(
                 f"fresh measurement series fails the runs test "
                 f"(|Z|={abs(z):.2f} > {z_crit}) — re-measure before "
                 "trusting the regression")
+    return verdict, checks
+
+
+def check_serve_regression(fresh: Dict[str, Any], baseline: Dict[str, Any],
+                           tol: float = 0.25) -> Dict[str, Any]:
+    """The SERVE_BENCH-family twin of :func:`check_regression`
+    (module docstring): segmented exact-tier pct99 as a ceiling,
+    verifier-call and shed reappearance as secondaries, the same
+    noise-aware downgrade over the fresh ``exact_samples_us`` series.
+    The default tolerance is wider than the bench gate's — wall-clock
+    microsecond latencies swing more host-to-host than paired ratios."""
+    reasons: List[str] = []
+    checks: Dict[str, Any] = {}
+
+    def exact(doc):
+        return ((doc.get("segmented") or {}).get("resolve_us") or {}).get(
+            "exact") or {}
+
+    f_p99, b_p99 = exact(fresh).get("pct99_us"), \
+        exact(baseline).get("pct99_us")
+    if f_p99 is not None and b_p99:
+        ceil = b_p99 * (1.0 + tol)
+        checks["exact_pct99_us"] = {"fresh": f_p99, "baseline": b_p99,
+                                    "ceiling": round(ceil, 1)}
+        if f_p99 > ceil:
+            reasons.append(
+                f"segmented exact pct99 {f_p99:.1f}us > {ceil:.1f}us "
+                f"(baseline {b_p99:.1f}us + {tol:.0%})")
+    f_ver = (fresh.get("segmented") or {}).get("verifier_calls")
+    b_ver = (baseline.get("segmented") or {}).get("verifier_calls")
+    if f_ver is not None and b_ver is not None:
+        checks["verifier_calls"] = {"fresh": f_ver, "baseline": b_ver}
+        if f_ver > b_ver:
+            # zero per-query verifier invocations is an admission-time
+            # design guarantee, not a tolerance band (docs/serving.md)
+            reasons.append(
+                f"per-query verifier calls reappeared "
+                f"({b_ver} -> {f_ver})")
+    f_shed = (fresh.get("segmented") or {}).get("shed")
+    b_shed = (baseline.get("segmented") or {}).get("shed")
+    if f_shed is not None and b_shed is not None:
+        checks["shed"] = {"fresh": f_shed, "baseline": b_shed}
+        if f_shed > b_shed:
+            reasons.append(f"shed responses grew ({b_shed} -> {f_shed}) "
+                           "at the same paced QPS")
+    verdict = "regression" if reasons else "ok"
+    samples = (fresh.get("segmented") or {}).get("exact_samples_us")
+    verdict, checks2 = _noise_downgrade(verdict, reasons, samples)
+    checks.update(checks2)
     return {"verdict": verdict, "tol": tol, "reasons": reasons,
-            "checks": checks}
+            "checks": checks, "family": "serve_trace_replay"}
 
 
 # -- recorded-database mining (numeric parse, no graph) ---------------------
@@ -417,7 +507,12 @@ def metrics_section(paths: List[str], top: int = 12) -> List[str]:
         for nm in sorted(hists,
                          key=lambda n: -hists[n].get("sum", 0.0))[:top]:
             h = hists[nm]
-            if h.get("truncated") or "raw_retained" in h:
+            if h.get("window"):
+                # windowed retention (obs/metrics.py): percentiles cover
+                # the most recent raw_retained observations
+                cov = (f"recent-window ({h.get('raw_retained', '?')}/"
+                       f"{h.get('count', '?')})")
+            elif h.get("truncated") or "raw_retained" in h:
                 # obs/metrics.py Histogram.summary: the raw series was
                 # capped; percentiles cover only the first raw_retained.
                 # Legacy summaries (pre-``truncated`` flag) carried only
@@ -676,6 +771,160 @@ def queue_section(queue_dir: str) -> List[str]:
     return lines
 
 
+# -- live fleet view (--follow) ---------------------------------------------
+
+def _age(doc: Dict[str, Any], key: str, now: float) -> str:
+    try:
+        return f"{now - float(doc.get(key, 0)):.1f}s"
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _slo_line(slo: Dict[str, Any]) -> str:
+    pct99 = slo.get("pct99_us")
+    bits = [f"{slo.get('histogram', '?')} pct99 "
+            f"{'—' if pct99 is None else f'{pct99:.1f}us'}"]
+    if slo.get("target_us") is not None:
+        mark = ("OK" if slo.get("within_target")
+                else "MISS" if slo.get("within_target") is False else "?")
+        bits.append(f"target {slo['target_us']:.0f}us [{mark}]")
+    if slo.get("baseline_pct99_us"):
+        bits.append(f"burn {slo.get('burn', '?')} "
+                    f"(x{slo.get('vs_baseline', '?')} vs baseline "
+                    f"{slo['baseline_pct99_us']:.1f}us)")
+    return ", ".join(bits)
+
+
+def fleet_lines(store_dirs: List[str],
+                queue_dirs: List[str]) -> List[str]:
+    """One render of the live fleet (docs/observability.md "Fleet
+    telemetry plane"): serve-loop and daemon status documents joined
+    with their latest metric snapshots — per-process liveness, queue
+    depth/age, tier hit mix, SLO state.  Pure reads: follow never
+    mutates the tree it watches."""
+    import time as _time
+
+    from tenzing_tpu.obs.metrics import latest_snapshots
+    from tenzing_tpu.serve.store import WorkQueue
+
+    now = _time.time()
+    lines = [f"# fleet @ {_time.strftime('%H:%M:%S')}", ""]
+    for d in store_dirs:
+        if not os.path.isdir(d):
+            continue
+        snaps = latest_snapshots(d)
+        for name in sorted(os.listdir(d)):
+            if not (name.startswith("status-") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    st = json.load(f)
+            except (OSError, ValueError):
+                lines.append(f"serve  {name}: unreadable")
+                continue
+            if st.get("kind") != "serve_loop":
+                continue
+            c = st.get("counters", {})
+            served = {t: c.get(f"served_{t}", 0)
+                      for t in ("exact", "near", "cold")}
+            total = sum(served.values()) or 1
+            mix = "/".join(f"{t}:{n} ({100 * n // total}%)"
+                           for t, n in served.items())
+            lines.append(
+                f"serve  {st.get('owner', name)}: {st.get('state')}, "
+                f"hb {_age(st, 'heartbeat_at', now)} ago, queue "
+                f"{st.get('queue_depth', 0)} (+{st.get('in_flight', 0)} "
+                f"in flight), shed {c.get('shed', 0)}, timeouts "
+                f"{c.get('timeouts', 0)}, mix {mix}")
+            snap = snaps.get(st.get("owner", ""))
+            if snap:
+                gauges = (snap.get("metrics") or {}).get("gauges", {})
+                tr = snap.get("tracer") or {}
+                extras = [f"queue age {gauges.get('serve.queue_age_s', 0)}s",
+                          f"shed rate {gauges.get('serve.shed_rate', 0)}/s"]
+                if tr.get("dropped_spans") or tr.get("dropped_events"):
+                    extras.append(
+                        f"tracer dropped {tr.get('dropped_spans', 0)}sp/"
+                        f"{tr.get('dropped_events', 0)}ev")
+                lines.append(f"       {', '.join(extras)}")
+                if snap.get("slo"):
+                    lines.append(f"       slo: {_slo_line(snap['slo'])}")
+    for qd in queue_dirs:
+        if not os.path.isdir(qd):
+            lines.append(f"queue  {qd}: missing directory")
+            continue
+        q = WorkQueue(qd)
+        items = q.items()
+        ages = []
+        for p, _ in items:
+            try:
+                ages.append(now - os.path.getmtime(p))
+            except OSError:
+                pass
+        leases = q.leases()
+        lines.append(
+            f"queue  {qd}: depth {len(items)}"
+            + (f", oldest {max(ages):.1f}s" if ages else "")
+            + (f", torn {len(q.torn_paths)}" if q.torn_paths else "")
+            + f", leases {len(leases)}"
+            + (f" (max hb age {max(l['age_s'] for l in leases):.1f}s)"
+               if leases else "")
+            + f", poisoned {len(q.poisoned())}")
+        snaps = latest_snapshots(qd)
+        for name in sorted(os.listdir(qd)):
+            if not (name.startswith("status-") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(qd, name)) as f:
+                    st = json.load(f)
+            except (OSError, ValueError):
+                lines.append(f"daemon {name}: unreadable")
+                continue
+            if st.get("kind") == "serve_loop":
+                continue  # a listen loop pointed at the queue dir
+            c = st.get("counters", {})
+            item = st.get("item") or {}
+            lines.append(
+                f"daemon {st.get('owner', name)}: {st.get('state')}, "
+                f"hb {_age(st, 'heartbeat_at', now)} ago, claimed "
+                f"{c.get('claimed', 0)}, completed {c.get('completed', 0)}"
+                f", retried {c.get('retried', 0)}, poisoned "
+                f"{c.get('poisoned', 0)}"
+                + (f", draining {str(item.get('exact', ''))[:12]} "
+                   f"({now - float(item.get('since', now)):.0f}s)"
+                   if item else ""))
+            snap = snaps.get(st.get("owner", ""))
+            if snap:
+                gauges = (snap.get("metrics") or {}).get("gauges", {})
+                lines.append(
+                    f"       item age "
+                    f"{gauges.get('daemon.item_age_s', 0)}s, lease age "
+                    f"{gauges.get('daemon.lease_age_s', 0)}s")
+    if len(lines) <= 2:
+        lines.append("(no status documents found)")
+    lines.append("")
+    return lines
+
+
+def follow(store_dirs: List[str], queue_dirs: List[str],
+           interval: float = 2.0, max_ticks: Optional[int] = None,
+           out=None) -> int:
+    """Render :func:`fleet_lines` every ``interval`` seconds until
+    Ctrl-C (or ``max_ticks`` renders — the CI/test bound)."""
+    out = out if out is not None else sys.stdout
+    ticks = 0
+    try:
+        while True:
+            out.write("\n".join(fleet_lines(store_dirs, queue_dirs)) + "\n")
+            out.flush()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 # -- CLI --------------------------------------------------------------------
 
 def _expand(globs: Optional[List[str]]) -> List[str]:
@@ -707,9 +956,26 @@ def build_report(args) -> Tuple[str, Optional[Dict[str, Any]]]:
     if stores or args.queue_dir:
         lines += store_section(stores, queue_dir=args.queue_dir)
     if args.check:
-        fresh = load_driver_json(args.check)
-        baseline = load_driver_json(args.baseline)
-        verdict = check_regression(fresh, baseline, tol=args.tol)
+        fresh = _load_check_doc(args.check)
+        baseline = _load_check_doc(args.baseline)
+        f_serve = fresh.get("kind") == "serve_trace_replay"
+        b_serve = baseline.get("kind") == "serve_trace_replay"
+        if f_serve != b_serve:
+            # a mixed pair means a mis-wired gate (e.g. a BENCH baseline
+            # against a SERVE_BENCH fresh): every extraction would come
+            # back None and the check would vacuously pass — fail the
+            # wiring loudly instead (exit 2, usage error)
+            raise ValueError(
+                f"regression-check family mismatch: {args.check} is "
+                f"{'serve-replay' if f_serve else 'driver'}-family but "
+                f"{args.baseline} is "
+                f"{'serve-replay' if b_serve else 'driver'}-family")
+        if f_serve:
+            # the SERVE_BENCH family gates on serving latency, not
+            # search quality — same CLI, same exit-code contract
+            verdict = check_serve_regression(fresh, baseline, tol=args.tol)
+        else:
+            verdict = check_regression(fresh, baseline, tol=args.tol)
         lines += ["## Regression check", "",
                   f"- fresh: `{args.check}`",
                   f"- baseline: `{args.baseline}` (tol {args.tol:.0%})",
@@ -756,9 +1022,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="relative regression tolerance (default 0.05)")
     ap.add_argument("--out", default=None,
                     help="write the markdown report here (default stdout)")
+    ap.add_argument("--follow", action="store_true",
+                    help="live fleet view: tail status + metric-snapshot "
+                         "documents under --store / --queue-dir "
+                         "(docs/observability.md)")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="SECS",
+                    help="--follow refresh interval")
+    ap.add_argument("--max-ticks", type=int, default=None,
+                    help="--follow: stop after N renders (CI/tests)")
     args = ap.parse_args(argv)
     if bool(args.check) != bool(args.baseline):
         ap.error("--check and --baseline must be given together")
+    if args.follow:
+        store_dirs = []
+        for p in args.store or []:
+            if os.path.isdir(p):
+                store_dirs.append(p)
+            elif p.endswith(".json"):
+                # a monolithic store: its status docs live beside it
+                store_dirs.append(os.path.dirname(os.path.abspath(p)))
+        if not store_dirs and not args.queue_dir:
+            ap.error("--follow needs --store and/or --queue-dir")
+        return follow(store_dirs,
+                      [args.queue_dir] if args.queue_dir else [],
+                      interval=args.interval, max_ticks=args.max_ticks)
     try:
         report, verdict = build_report(args)
     except (OSError, ValueError) as e:

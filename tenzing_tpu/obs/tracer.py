@@ -21,6 +21,19 @@ tracer starts disabled, and a disabled ``span()`` / ``event()`` returns a
 shared no-op immediately — no allocation, no locking, no timestamp (the
 contract tests/test_obs.py::test_disabled_tracer_is_noop relies on).  Enable
 it process-wide with :func:`configure` (what ``bench.py --trace-out`` does).
+
+While a cross-process trace context is ambient (obs/context.py — minted
+at serve-listen ingress, adopted by drain daemons and their children),
+every recorded span and event is additionally stamped with ``trace_id``
+/ ``parent_span`` attrs, so bundles from different fleet processes
+stitch into one request journey (obs/export.py ``stitch``).
+
+**Retention is bounded**: a long-lived process (``serve listen``, the
+drain daemon) records forever, so the span/event buffers are rings —
+beyond ``max_spans`` / ``max_events`` the OLDEST records are evicted
+(the tail is what a live dashboard and a post-mortem read) and
+``dropped_spans`` / ``dropped_events`` count what fell off, surfaced in
+metric snapshots so silent loss is impossible.
 """
 
 from __future__ import annotations
@@ -28,8 +41,17 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Deque, Dict, Iterator, List, Optional
+
+from tenzing_tpu.obs.context import current_trace_attrs
+
+# the default span/event ring bounds: generous enough that every search
+# bundle to date fits untruncated, small enough that a multi-hour serve
+# loop stays O(100 MB) worst-case instead of unbounded
+MAX_SPANS = 200_000
+MAX_EVENTS = 200_000
 
 
 def short_digest(payload: str) -> str:
@@ -129,15 +151,21 @@ _NULL_CTX = _NullSpanCtx()
 class Tracer:
     """Thread-safe span/event recorder (see module docstring)."""
 
-    def __init__(self, enabled: bool = True, rank: int = 0):
+    def __init__(self, enabled: bool = True, rank: int = 0,
+                 max_spans: int = MAX_SPANS, max_events: int = MAX_EVENTS):
         self.enabled = enabled
         self.rank = rank
         self._lock = threading.Lock()
-        self._spans: List[Span] = []
-        self._events: List[Event] = []
+        # bounded rings (module docstring): a full ring evicts oldest
+        # and counts the drop — a serve loop cannot grow without bound
+        self._spans: Deque[Span] = deque(maxlen=max(1, max_spans))
+        self._events: Deque[Event] = deque(maxlen=max(1, max_events))
+        self.dropped_spans = 0
+        self.dropped_events = 0
         self._listeners: List[Callable[[str, Any], None]] = []
         self._local = threading.local()
         self._tids: Dict[int, int] = {}
+        self._next_tid = 0
         # live per-thread open-span stacks, keyed by thread ident: the
         # export-time flush (ISSUE 3 satellite) reads OTHER threads' stacks
         # to close in-flight spans, so the stacks must be reachable beyond
@@ -157,7 +185,15 @@ class Tracer:
         tid = self._tids.get(ident)
         if tid is None:
             with self._lock:
-                tid = self._tids.setdefault(ident, len(self._tids))
+                tid = self._tids.get(ident)
+                if tid is None:
+                    # a monotonic counter, not len(): dead-thread idents
+                    # are pruned at snapshot time (a socket serve loop
+                    # spawns one reader thread per connection, forever),
+                    # and a pruned-then-reused index would merge two
+                    # different threads' tracks
+                    tid = self._tids[ident] = self._next_tid
+                    self._next_tid += 1
         return tid
 
     def _stack(self) -> List[Span]:
@@ -195,6 +231,14 @@ class Tracer:
     def _span_ctx(self, name: str, attrs: Dict[str, Any]) -> Iterator[Span]:
         stack = self._stack()
         parent = stack[-1].span_id if stack else None
+        trace = current_trace_attrs()
+        if trace is not None:
+            # stamp the ambient cross-process context (obs/context.py);
+            # explicit attrs win, and nested spans need no parent_span —
+            # their in-process parent chain already resolves
+            if parent is not None:
+                trace = {"trace_id": trace["trace_id"]}
+            attrs = {**trace, **attrs}
         with self._lock:
             span_id = self._next_span_id
             self._next_span_id += 1
@@ -207,6 +251,8 @@ class Tracer:
             sp.dur_us = self._now_us() - sp.ts_us
             stack.pop()
             with self._lock:
+                if len(self._spans) == self._spans.maxlen:
+                    self.dropped_spans += 1  # ring full: oldest evicts
                 self._spans.append(sp)
             self._notify("span", sp)
 
@@ -214,8 +260,13 @@ class Tracer:
         """Record one instant event."""
         if not self.enabled:
             return
+        trace = current_trace_attrs()
+        if trace is not None:
+            attrs = {"trace_id": trace["trace_id"], **attrs}
         ev = Event(name, self._now_us(), self.rank, self._tid(), attrs)
         with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped_events += 1
             self._events.append(ev)
         self._notify("event", ev)
 
@@ -252,6 +303,18 @@ class Tracer:
             stacks = [list(s) for s in list(self._open_stacks.values())]
             spans = list(self._spans)
             events = list(self._events)
+            if acquired:
+                # retention housekeeping (safe only under the real lock):
+                # threads die but their ident keys do not — a socket serve
+                # loop makes one reader thread per connection, so the
+                # stack/tid maps of DEAD threads with nothing in flight
+                # are pruned here, the one periodic read every long-lived
+                # process already performs
+                live = {t.ident for t in threading.enumerate()}
+                for ident in [i for i, s in self._open_stacks.items()
+                              if not s and i not in live]:
+                    del self._open_stacks[ident]
+                    self._tids.pop(ident, None)
         finally:
             if acquired:
                 self._lock.release()
@@ -274,6 +337,21 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self._events.clear()
+            self.dropped_spans = 0
+            self.dropped_events = 0
+
+    def retention(self) -> Dict[str, int]:
+        """Buffer occupancy + drop counts — what metric snapshots carry
+        so ring eviction in a long-lived process is visible, never
+        silent (obs/metrics.py ``MetricsSnapshotWriter``)."""
+        return {
+            "spans": len(self._spans),
+            "events": len(self._events),
+            "max_spans": self._spans.maxlen or 0,
+            "max_events": self._events.maxlen or 0,
+            "dropped_spans": self.dropped_spans,
+            "dropped_events": self.dropped_events,
+        }
 
 
 # -- process-global tracer -------------------------------------------------

@@ -86,6 +86,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.add_argument("--tenant", default="local",
                        help="provenance tenant tag for records added "
                             "through this process")
+        p.add_argument("--trace-out", default=None, metavar="PATH",
+                       help="enable tracing; write this process's "
+                            "telemetry JSONL bundle here (stitch fleet "
+                            "bundles with python -m tenzing_tpu.obs."
+                            "export)")
 
     def request_flags(p):
         p.add_argument("--workload",
@@ -160,6 +165,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip lazy re-verification of unstamped records")
     pl.add_argument("--near-max-sigma", type=float, default=0.75,
                     help="near-miss uncertainty gate")
+    pl.add_argument("--slo-target-us", type=float, default=None,
+                    help="exact-tier pct99 objective for the SLO block "
+                         "in metric snapshots (docs/observability.md)")
+    pl.add_argument("--slo-baseline", default=None, metavar="PATH",
+                    help="committed SERVE_BENCH_r*.json anchoring the "
+                         "SLO burn direction")
+    pl.add_argument("--metrics-ring", type=int, default=8,
+                    help="metric-snapshot files kept per owner")
 
     pc = sub.add_parser("compact",
                         help="one offline compaction pass over a "
@@ -180,6 +193,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     default=None, help=argparse.SUPPRESS)
 
     args = ap.parse_args(argv)
+    trace_out = getattr(args, "trace_out", None)
+    if trace_out:
+        from tenzing_tpu import obs
+
+        obs.configure(enabled=True)
     if args.cmd == "compact":
         from tenzing_tpu.serve.segments import Compactor
 
@@ -211,13 +229,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             shed_retry_after_secs=args.shed_retry_after,
             heartbeat_secs=args.heartbeat,
             idle_exit_secs=args.idle_exit, owner=args.owner or "",
-            status_path=args.status, socket_path=args.socket)
+            status_path=args.status, socket_path=args.socket,
+            slo_target_us=args.slo_target_us,
+            slo_baseline=args.slo_baseline,
+            metrics_ring=args.metrics_ring, trace_out=trace_out)
         loop = ServeLoop(svc, opts,
                          log=lambda m: sys.stderr.write(m + "\n"))
         if args.socket:
             _emit(loop.serve_socket(args.socket))
         else:
             _emit(loop.serve_stdin())
+        return 0
+    if trace_out:
+        # one-shot subcommands archive their bundle after the verdict
+        # line (the listen loop writes its own on drain)
+        from tenzing_tpu import obs
+
+        obs.write_jsonl(obs.get_tracer(), trace_out)
+        sys.stderr.write(f"trace bundle: {trace_out}\n")
     return 0
 
 

@@ -95,7 +95,8 @@ from tenzing_tpu.fault.errors import (
     TransientError,
     classify_error,
 )
-from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs import context as obs_context
+from tenzing_tpu.obs.metrics import MetricsSnapshotWriter, get_metrics
 from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.serve.lease import LeaseFile
 from tenzing_tpu.serve.store import WorkQueue
@@ -103,6 +104,10 @@ from tenzing_tpu.utils.atomic import atomic_dump_json
 
 STATUS_VERSION = 1
 FAIL_VERSION = 1
+# set (to the daemon's choice) when the daemon itself traces: the drain
+# child reads it and archives its own bundle under the item's checkpoint
+# directory, the third leg of the stitched fleet trace
+TRACE_CHILD_ENV = "TENZING_TRACE_CHILD"
 # a long-lived daemon visits items forever; every in-memory / on-disk
 # accumulation is bounded (consumers only ever read the tail anyway)
 HISTORY_CAP = 200
@@ -199,12 +204,36 @@ def exec_item(payload: Dict[str, Any], item_path: str,
     re-warm mines.  Returns the driver verdict dict; raises a classified
     error on failure (a backend-init verdict — the tunnel is down — is a
     :class:`TransientError`, not an answer)."""
+    # adopt the originating query's trace context — the envelope copy
+    # first (SIGKILL-survivable: a successor daemon re-reads it from
+    # disk), the env var as the live-parent fallback — as the process
+    # default, so every span the drive emits (any thread) links back to
+    # the query.  Restored on the way out: the in-process runner drains
+    # many items in one process, and item N's context must not bleed
+    # into item N+1.
+    ctx = (obs_context.from_json(payload.get("trace"))
+           or obs_context.from_env())
+    prev_ctx = obs_context.set_process_default(ctx) if ctx is not None \
+        else None
+    try:
+        return _exec_item(payload, item_path, overrides)
+    finally:
+        if ctx is not None:
+            obs_context.set_process_default(prev_ctx)
+
+
+def _exec_item(payload: Dict[str, Any], item_path: str,
+               overrides: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     from tenzing_tpu.bench.driver import run
 
     req = apply_overrides(payload["request"], overrides)
     ckpt = drain_checkpoint_of(payload, item_path)
     os.makedirs(ckpt, exist_ok=True)
     req.checkpoint = ckpt
+    if os.environ.get(TRACE_CHILD_ENV) and not req.trace_out:
+        # the tracing daemon asked its children to archive their own
+        # bundles: one directory per item, next to the drain journal
+        req.trace_out = os.path.join(ckpt, "trace")
     # resume iff a previous drain already journaled state there: the
     # successor of a SIGKILLed worker replays every landed measurement
     # instead of re-paying the device (fault/checkpoint.py)
@@ -265,6 +294,11 @@ class DaemonOpts:
     model_path: Optional[str] = None
     handle_signals: bool = True      # SIGTERM/SIGINT graceful stop
     overrides: Dict[str, Any] = field(default_factory=dict)
+    # enable tracing and write this daemon's JSONL bundle here on exit;
+    # drain children then archive their own bundles under each item's
+    # ckpt-<exact>/trace/ (the stitched fleet trace's second/third legs)
+    trace_out: Optional[str] = None
+    metrics_ring: int = 8            # metric-snapshot ring per owner
 
 
 class DrainDaemon:
@@ -283,6 +317,12 @@ class DrainDaemon:
                                   else self._run_subprocess)
         self.status_path = opts.status_path or os.path.join(
             opts.queue_dir, f"status-{self.owner}.json")
+        # streaming metric snapshots next to the status doc (bounded
+        # ring, obs/metrics.py) — written on every status rewrite, read
+        # by the report CLI's --follow fleet view
+        self._snapshots = MetricsSnapshotWriter(
+            os.path.dirname(os.path.abspath(self.status_path)), self.owner,
+            ring=opts.metrics_ring)
         self.counters: Dict[str, int] = {
             k: 0 for k in ("claimed", "completed", "retried", "poisoned",
                            "reclaimed", "released", "failed_transient",
@@ -370,6 +410,12 @@ class DrainDaemon:
             atomic_dump_json(self.status_path, doc, prefix=".status.")
         except OSError as e:
             self._log(f"status write failed ({e})")
+        try:
+            self._snapshots.write(state=state, extra={
+                "counters": dict(self.counters),
+                "queue_depth": self._depth})
+        except OSError as e:
+            self._log(f"metrics snapshot failed ({e})")
 
     # -- failure history / poison -------------------------------------------
     def _load_fail_doc(self, exact: str) -> Dict[str, Any]:
@@ -474,8 +520,18 @@ class DrainDaemon:
             cmd += ["--override", f"{k}={json.dumps(v)}"]
         log_path = os.path.join(ckpt, "drain.log")
         deadline = (time.time() + timeout) if timeout else None
+        # the child inherits the item's trace context via the
+        # environment (obs/context.py TRACE_ENV; the envelope's `trace`
+        # key is the redundant, SIGKILL-survivable copy) and — when this
+        # daemon traces — the ask to archive its own bundle
+        env = obs_context.to_env(
+            dict(os.environ),
+            obs_context.from_json(payload.get("trace")))
+        if self.opts.trace_out:
+            env[TRACE_CHILD_ENV] = "1"
         with open(log_path, "ab") as log_f:
-            proc = subprocess.Popen(cmd, stdout=log_f, stderr=log_f)
+            proc = subprocess.Popen(cmd, stdout=log_f, stderr=log_f,
+                                    env=env)
             self._child = proc
             try:
                 rc = self._wait_child(proc, deadline)
@@ -705,6 +761,18 @@ class DrainDaemon:
         self._depth = len(items)
         reg = get_metrics()
         reg.gauge("daemon.queue_depth").set(float(len(items)))
+        # queue age: how long the oldest still-queued item has waited —
+        # the fleet-sizing signal (depth alone hides a stuck old item
+        # behind a churning queue)
+        now = time.time()
+        ages = []
+        for path, _ in items:
+            try:
+                ages.append(now - os.path.getmtime(path))
+            except OSError:
+                pass  # claimed + deleted mid-scan
+        reg.gauge("daemon.item_age_s").set(
+            round(max(ages), 3) if ages else 0.0)
         leases = self.queue.leases()
         if leases:
             reg.gauge("daemon.lease_age_s").set(
@@ -747,6 +815,10 @@ class DrainDaemon:
         ``--idle-exit`` says done); returns the summary dict the CLI
         prints as its one JSON line."""
         self._install_signals()
+        if self.opts.trace_out:
+            from tenzing_tpu.obs.tracer import configure
+
+            configure(enabled=True)
         tr = get_tracer()
         drained = 0
         idle_since: Optional[float] = None
@@ -776,8 +848,16 @@ class DrainDaemon:
                         continue
                     processed += 1
                     try:
-                        with tr.span("daemon.drain", exact=exact,
-                                     owner=self.owner) as sp:
+                        # the item's trace context (stamped at enqueue
+                        # by the query that went cold) is ambient for
+                        # the whole drain: the daemon.drain span, the
+                        # store merge, and — via env + envelope — the
+                        # subprocess's own spans all carry its trace_id
+                        with obs_context.use(
+                                obs_context.from_json(
+                                    payload.get("trace"))), \
+                                tr.span("daemon.drain", exact=exact,
+                                        owner=self.owner) as sp:
                             outcome = self._drain_one(path, payload, lease)
                             sp.set("outcome", outcome)
                     except _Interrupted:
@@ -813,6 +893,14 @@ class DrainDaemon:
             self._observe_queue()
             self._write_status(state)
             self._restore_signals()
+            if self.opts.trace_out:
+                from tenzing_tpu.obs.export import write_jsonl
+
+                try:
+                    write_jsonl(tr, self.opts.trace_out)
+                    self._log(f"trace bundle: {self.opts.trace_out}")
+                except OSError as e:
+                    self._log(f"trace bundle failed ({e})")
         return {
             "owner": self.owner,
             "state": state,
@@ -874,6 +962,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="K=V",
                     help="request-budget override applied to every drained "
                          "item (e.g. mcts_iters=8); identity fields refuse")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="enable tracing; write this daemon's telemetry "
+                         "JSONL bundle here on exit (drain children "
+                         "archive theirs under each item's ckpt dir) — "
+                         "stitch with python -m tenzing_tpu.obs.export")
     # the subprocess entry — not for operators (the daemon spawns it)
     ap.add_argument("--exec-item", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--verdict-out", default=None, help=argparse.SUPPRESS)
@@ -898,7 +991,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         once=args.once, max_items=args.max_items,
         idle_exit_secs=args.idle_exit, topk=args.topk, train=args.train,
         in_process=args.in_process, status_path=args.status,
-        model_path=args.model, overrides=overrides)
+        model_path=args.model, overrides=overrides,
+        trace_out=args.trace_out)
     daemon = DrainDaemon(opts)
     summary = daemon.run()
     sys.stdout.write(json.dumps(summary) + "\n")

@@ -39,6 +39,19 @@ load-shedding request loop (docs/serving.md "Listen mode"):
   depth, per-tier served counts, shed/timeout tallies — the same
   liveness-probe contract as the drain daemon's status document, and
   the report CLI renders both.
+* **Telemetry plane** (docs/observability.md "Fleet telemetry plane")
+  — every request is minted a cross-process **trace context** at
+  ingress (obs/context.py; a client-supplied ``trace`` key is adopted
+  instead, so an upstream gateway's ids survive): the context stamps
+  every span/event resolution emits, rides a cold query's work-item
+  envelope into the drain daemon, and is echoed back as ``trace_id``
+  on every response.  The heartbeat additionally publishes **metric
+  snapshots** (obs/metrics.py ``MetricsSnapshotWriter`` — a bounded
+  ring of atomic documents next to the status doc) carrying per-tier /
+  per-tenant latency histograms, queue-age and shed-rate gauges, and
+  an SLO block (exact pct99 vs ``--slo-target-us`` and vs the
+  committed SERVE_BENCH baseline); the ``metrics`` protocol verb
+  answers the same document on demand.
 
 Every response carries ``resolve_us`` (the resolution's own latency,
 excluding queue wait) so a replaying client can build the latency
@@ -60,11 +73,18 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from tenzing_tpu.fault.errors import classify_error
-from tenzing_tpu.obs.metrics import get_metrics
+from tenzing_tpu.obs import context as obs_context
+from tenzing_tpu.obs.metrics import (
+    MetricsSnapshotWriter,
+    SloConfig,
+    baseline_pct99_from,
+    get_metrics,
+)
 from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.utils.atomic import atomic_dump_json
 
 STATUS_VERSION = 1
+_OPS = ("query", "batch", "stats", "ping", "metrics")
 
 
 @dataclass
@@ -81,6 +101,15 @@ class ListenOpts:
     status_path: Optional[str] = None
     socket_path: Optional[str] = None
     handle_signals: bool = True
+    # -- telemetry plane (docs/observability.md) --
+    slo_target_us: Optional[float] = None    # exact-tier pct99 objective
+    slo_baseline: Optional[str] = None       # SERVE_BENCH_r*.json path
+    metrics_ring: int = 8                    # snapshot files per owner
+    trace_out: Optional[str] = None          # JSONL bundle written on drain
+    # distinct per-tenant histogram labels admitted before new tenants
+    # aggregate under "other" — per-tenant series must not let a
+    # client-controlled string grow the registry without bound
+    tenant_cap: int = 16
 
 
 class _Pending:
@@ -89,16 +118,17 @@ class _Pending:
     the response; everyone else's attempt is a no-op."""
 
     __slots__ = ("rid", "payload", "respond", "enqueued_at", "deadline",
-                 "_done", "_lock")
+                 "ctx", "_done", "_lock")
 
     def __init__(self, rid, payload: Dict[str, Any],
                  respond: Callable[[Dict[str, Any]], None],
-                 deadline: Optional[float]):
+                 deadline: Optional[float], ctx=None):
         self.rid = rid
         self.payload = payload
         self.respond = respond
         self.enqueued_at = time.time()
         self.deadline = deadline
+        self.ctx = ctx  # the request's TraceContext (minted at ingress)
         self._done = False
         self._lock = threading.Lock()
 
@@ -110,6 +140,10 @@ class _Pending:
         out = dict(doc)
         if self.rid is not None:
             out["id"] = self.rid
+        if self.ctx is not None and "trace_id" not in out:
+            # every response names its trace — shed and watchdog answers
+            # included, so a client can correlate even its non-answers
+            out["trace_id"] = self.ctx.trace_id
         try:
             self.respond(out)
         except Exception:
@@ -164,6 +198,20 @@ class ServeLoop:
                 else ".")
         self.status_path = self.opts.status_path or os.path.join(
             base, f"status-{self.owner}.json")
+        # the streaming metrics exporter (obs/metrics.py): a bounded
+        # ring of snapshot documents next to the status doc, written on
+        # every heartbeat and answered by the `metrics` protocol verb
+        baseline = (baseline_pct99_from(self.opts.slo_baseline)
+                    if self.opts.slo_baseline else None)
+        self._snapshots = MetricsSnapshotWriter(
+            os.path.dirname(os.path.abspath(self.status_path)), self.owner,
+            ring=self.opts.metrics_ring,
+            slo=SloConfig(target_us=self.opts.slo_target_us,
+                          baseline_pct99_us=baseline))
+        # tenants admitted to their own latency series; the cap guards
+        # the registry against client-controlled label cardinality
+        self._tenants: "set[str]" = set()
+        self._shed_window = (time.time(), 0)  # (window start, sheds then)
 
     def _log(self, msg: str) -> None:
         if self._log_fn is not None:
@@ -206,17 +254,21 @@ class ServeLoop:
         self._bump("requests")
         self.last_request_at = time.time()
         if not isinstance(payload, dict) or \
-                payload.get("op", "query") not in ("query", "batch",
-                                                   "stats", "ping"):
+                payload.get("op", "query") not in _OPS:
             self._bump("malformed")
             _Pending(rid, {}, respond, None).complete({
                 "ok": False, "error": "malformed request "
-                "(op must be query|batch|stats|ping)",
+                f"(op must be {'|'.join(_OPS)})",
                 "error_class": "deterministic"})
             return
+        # ingress: mint (or adopt the client's) cross-process trace
+        # context — THE id that follows this request through resolution,
+        # a cold enqueue, the daemon drain, and the store merge
+        ctx = (obs_context.from_json(payload.get("trace"))
+               or obs_context.new_trace())
         deadline = (time.time() + self.opts.request_timeout_secs
                     if self.opts.request_timeout_secs else None)
-        pending = _Pending(rid, payload, respond, deadline)
+        pending = _Pending(rid, payload, respond, deadline, ctx=ctx)
         if self._stop.is_set():
             self._shed(pending, reason="draining")
             return
@@ -246,7 +298,21 @@ class ServeLoop:
             "error_class": "transient"})
 
     # -- workers -------------------------------------------------------------
-    def _resolve_one(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def _tenant_label(self, tenant: Optional[str]) -> Optional[str]:
+        """The bounded per-tenant histogram label: the first
+        ``tenant_cap`` distinct tenants get their own series, later ones
+        aggregate under ``other`` (still measured, never unbounded)."""
+        if not tenant or not isinstance(tenant, str):
+            return None
+        if tenant in self._tenants:
+            return tenant
+        if len(self._tenants) < max(0, self.opts.tenant_cap):
+            self._tenants.add(tenant)
+            return tenant
+        return "other"
+
+    def _resolve_one(self, request: Dict[str, Any],
+                     tenant: Optional[str] = None) -> Dict[str, Any]:
         from tenzing_tpu.bench.driver import DriverRequest
 
         with self._resolve_lock:
@@ -255,9 +321,29 @@ class ServeLoop:
             t0 = time.perf_counter()
             res = self.service.query(DriverRequest(**(request or {})))
             dt_us = (time.perf_counter() - t0) * 1e6
-        out = res.to_json()
+        # response serialization is a real per-hit phase (the ROADMAP's
+        # tens-of-µs item profiles it): timed + sub-spanned like the
+        # resolver's fingerprint/cache-probe phases
+        tr = get_tracer()
+        t_ser = time.perf_counter()
+        if tr.enabled:
+            with tr.span("serve.serialize", tier=res.tier):
+                out = res.to_json()
+        else:
+            out = res.to_json()
+        ser_us = round((time.perf_counter() - t_ser) * 1e6, 2)
+        out.setdefault("phase_us", {})["serialize"] = ser_us
         out["resolve_us"] = round(dt_us, 1)
         self._bump(f"served_{res.tier}")
+        label = self._tenant_label(tenant)
+        if label is not None:
+            reg = get_metrics()
+            reg.counter(f"serve.tenant.{label}.{res.tier}").inc()
+            # small WINDOWED cap (obs/metrics.py): one long-lived loop
+            # serves many tenants, and the snapshot percentiles must
+            # cover the recent window, not the first 4096 ever seen
+            reg.histogram(f"serve.tenant.{label}.resolve_us",
+                          max_raw=4096, window=True).observe(dt_us)
         return out
 
     def _handle(self, pending: _Pending) -> Dict[str, Any]:
@@ -265,9 +351,14 @@ class ServeLoop:
         op = payload.get("op", "query")
         if op == "ping":
             return {"ok": True, "pong": True, "owner": self.owner}
+        if op == "metrics":
+            # the on-demand twin of the heartbeat's snapshot documents
+            return {"ok": True, "metrics": self._snapshots.build(
+                state="serving", extra=self._snapshot_extra())}
         if op == "stats":
             with self._resolve_lock:
                 return {"ok": True, "stats": self.service.stats()}
+        tenant = payload.get("tenant")
         if op == "batch":
             reqs = payload.get("requests") or []
             self._bump("batches")
@@ -275,14 +366,16 @@ class ServeLoop:
             results = []
             for r in reqs:
                 req = r.get("request", r) if isinstance(r, dict) else {}
+                t = r.get("tenant", tenant) if isinstance(r, dict) else tenant
                 try:
-                    results.append(self._resolve_one(req))
+                    results.append(self._resolve_one(req, tenant=t))
                 except Exception as e:
                     results.append({"error": str(e)[:500],
                                     "error_class": classify_error(e)})
             return {"ok": True, "results": results}
         return {"ok": True,
-                "result": self._resolve_one(payload.get("request") or {})}
+                "result": self._resolve_one(payload.get("request") or {},
+                                            tenant=tenant)}
 
     def _worker(self) -> None:
         while True:
@@ -296,7 +389,11 @@ class ServeLoop:
                 if pending.done:
                     continue  # timed out while queued: already answered
                 try:
-                    doc = self._handle(pending)
+                    # the request's trace context is ambient for the
+                    # whole handling: resolution spans, a cold enqueue's
+                    # envelope, and any store flush all stamp it
+                    with obs_context.use(pending.ctx):
+                        doc = self._handle(pending)
                 except Exception as e:
                     self._bump("errors")
                     get_metrics().counter("serve.listen.errors").inc()
@@ -338,14 +435,48 @@ class ServeLoop:
                     self._queue.empty():
                 return
 
+    def _snapshot_extra(self) -> Dict[str, Any]:
+        """The loop-level block metric snapshots carry beside the raw
+        registry: the counters the status doc publishes plus the
+        derived queue-age / shed-rate gauges."""
+        return {"counters": dict(self.counters),
+                "queue_depth": self._queue.qsize(),
+                "in_flight": len(self._live)}
+
+    def _observe_gauges(self) -> None:
+        reg = get_metrics()
+        reg.gauge("serve.queue_depth").set(float(self._queue.qsize()))
+        # queue age: the oldest still-unanswered request's wait so far —
+        # depth says how many, age says how badly they are aging
+        now = time.time()
+        with self._live_lock:
+            oldest = min((p.enqueued_at for p in self._live
+                          if not p.done), default=None)
+        reg.gauge("serve.queue_age_s").set(
+            round(now - oldest, 3) if oldest is not None else 0.0)
+        # shed rate over the last heartbeat window (sheds/sec)
+        t0, sheds0 = self._shed_window
+        sheds = self.counters.get("shed", 0)
+        dt = max(1e-6, now - t0)
+        reg.gauge("serve.shed_rate").set(round((sheds - sheds0) / dt, 4))
+        self._shed_window = (now, sheds)
+
     def _heartbeat(self) -> None:
         while not self._stop.wait(self.opts.heartbeat_secs):
             self._write_status("serving")
-            get_metrics().gauge("serve.queue_depth").set(
-                float(self._queue.qsize()))
+            self._observe_gauges()
+            try:
+                self._snapshots.write(state="serving",
+                                      extra=self._snapshot_extra())
+            except OSError as e:
+                self._log(f"metrics snapshot failed ({e})")
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
+        if self.opts.trace_out:
+            from tenzing_tpu.obs.tracer import configure
+
+            configure(enabled=True)
         for i in range(max(1, self.opts.workers)):
             t = threading.Thread(target=self._worker,
                                  name=f"serve-worker-{i}", daemon=True)
@@ -378,6 +509,23 @@ class ServeLoop:
             t.join(timeout=max(0.1, deadline - time.time()))
         ok = self._queue.empty()
         self._write_status("stopped")
+        self._observe_gauges()
+        try:
+            self._snapshots.write(state="stopped",
+                                  extra=self._snapshot_extra())
+        except OSError as e:
+            self._log(f"metrics snapshot failed ({e})")
+        if self.opts.trace_out:
+            # the loop's own telemetry bundle — one leg of the stitched
+            # fleet trace (obs/export.py stitch)
+            from tenzing_tpu.obs.export import write_jsonl
+            from tenzing_tpu.obs.tracer import get_tracer as _gt
+
+            try:
+                write_jsonl(_gt(), self.opts.trace_out)
+                self._log(f"trace bundle: {self.opts.trace_out}")
+            except OSError as e:
+                self._log(f"trace bundle failed ({e})")
         return ok
 
     def _on_signal(self, signum, frame) -> None:

@@ -50,7 +50,12 @@ from typing import Any, Dict, List, Optional, Tuple
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.utils.numeric import percentile
 
-REPLAY_VERSION = 1
+REPLAY_VERSION = 2
+# raw exact-tier latency series retained in the result document (replay
+# order preserved): the regression gate's noise-awareness runs the
+# bench/randomness.py runs test over it — and 512 points bound the
+# committed SERVE_BENCH file size
+EXACT_SAMPLES_CAP = 512
 
 # per-workload shape knob: (field, near value, cold values) — "exact"
 # queries use the warmed default shape; "near" sits in its power-of-two
@@ -107,6 +112,25 @@ def _series(lat_by_tier: Dict[str, List[float]]) -> Dict[str, Any]:
     return out
 
 
+def _phase_series(phase_lat: Dict[str, List[float]]) -> Dict[str, Any]:
+    """Per-phase latency summary (fingerprint / cache_probe /
+    store_walk / serialize — resolver + transport phase stamps): THE
+    exact-tier profile the ROADMAP's tens-of-µs item optimizes against
+    (docs/serving.md 'Trace-replay benchmark')."""
+    out: Dict[str, Any] = {}
+    for phase, xs in sorted(phase_lat.items()):
+        if not xs:
+            continue
+        s = sorted(xs)
+        out[phase] = {
+            "count": len(s),
+            "pct50_us": round(percentile(s, 50), 2),
+            "pct99_us": round(percentile(s, 99), 2),
+            "sum_us": round(sum(s), 1),
+        }
+    return out
+
+
 def _warm_stores(workdir: str, csv_globs: Dict[str, List[str]],
                  topk: int, log) -> Dict[str, Any]:
     """Warm a monolithic and a segmented store identically from the
@@ -158,16 +182,21 @@ def _replay_legacy(mono_path: str, queue_dir: str, model_path: str,
         resolver.resolve(DriverRequest(**json.loads(kw)))  # warmup
     fallback0 = get_metrics().counter("serve.verify_fallback").value
     lat: Dict[str, List[float]] = {}
+    phases: Dict[str, List[float]] = {}
     t_start = time.perf_counter()
     for req in reqs:
         t0 = time.perf_counter()
         res = resolver.resolve(req)
         lat.setdefault(res.tier, []).append(
             (time.perf_counter() - t0) * 1e6)
+        if res.tier == "exact":
+            for phase, us in res.phase_us.items():
+                phases.setdefault(phase, []).append(us)
     wall = time.perf_counter() - t_start
     return {
         "mode": "monolithic-legacy",
         "resolve_us": _series(lat),
+        "phases_us": _phase_series(phases),
         "verifier_calls": get_metrics().counter(
             "serve.verify_fallback").value - fallback0,
         "wall_s": round(wall, 3),
@@ -214,6 +243,8 @@ def _replay_segmented(seg_path: str, queue_dir: str,
     loop.drain(timeout=max(30.0, request_timeout * 2))
     wall = time.perf_counter() - t_start
     lat: Dict[str, List[float]] = {}
+    phases: Dict[str, List[float]] = {}
+    exact_samples: List[float] = []
     shed = timeouts = errors = cache_hits = 0
     for doc in results:
         if doc.get("shed"):
@@ -225,11 +256,21 @@ def _replay_segmented(seg_path: str, queue_dir: str,
         else:
             r = doc["result"]
             lat.setdefault(r["tier"], []).append(r["resolve_us"])
+            if r["tier"] == "exact":
+                # the exact tier's per-phase profile + a bounded raw
+                # series (replay order) for the noise-aware regression
+                # gate (obs/report.py check_regression, serve family)
+                for phase, us in (r.get("phase_us") or {}).items():
+                    phases.setdefault(phase, []).append(us)
+                if len(exact_samples) < EXACT_SAMPLES_CAP:
+                    exact_samples.append(r["resolve_us"])
             if r.get("provenance", {}).get("cache_hit"):
                 cache_hits += 1
     return {
         "mode": "segmented",
         "resolve_us": _series(lat),
+        "phases_us": _phase_series(phases),
+        "exact_samples_us": exact_samples,
         "verifier_calls": get_metrics().counter(
             "serve.verify_fallback").value - fallback0,
         "shed": shed,

@@ -27,8 +27,19 @@ The serving path's answer policy, in strictly-cheaper-first order
   (serve/store.py ``WorkQueue``) for a driver to drain, and say so.
 
 Every resolution lands a ``serve.query`` span, a ``serve.<tier>``
-counter, and a ``serve.resolve_us`` latency observation
-(docs/observability.md).
+counter, and a ``serve.resolve_us`` latency observation — plus a
+per-tier ``serve.resolve_us.<tier>`` series and a **per-phase
+breakdown** (``Resolution.phase_us``: fingerprint canonicalization,
+exact-cache probe, store walk) — the profile the ROADMAP's
+tens-of-µs exact-tier item steers by (docs/observability.md).
+
+Resolution runs under a cross-process trace context (obs/context.py):
+the caller's (serve/listen.py mints one per request at ingress), or one
+minted here for context-less callers (the one-shot ``serve query``
+CLI).  The context stamps every span/event on the path and rides the
+cold tier's work-item envelope, so the daemon drain a cold query causes
+is linkable back to the query (docs/observability.md "Fleet telemetry
+plane").
 """
 
 from __future__ import annotations
@@ -38,6 +49,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from tenzing_tpu.obs import context as obs_context
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.serve.fingerprint import WorkloadFingerprint, fingerprint_of
@@ -59,6 +71,11 @@ class Resolution:
     vs_naive: Optional[float] = None
     provenance: Dict[str, Any] = field(default_factory=dict)
     work_item: Optional[str] = None  # cold: the queued item's path
+    # per-phase latency breakdown (µs): fingerprint / cache_probe /
+    # store_walk (+ serialize, added by the transport) — the exact-tier
+    # profile serve/replay.py aggregates into SERVE_BENCH documents
+    phase_us: Dict[str, float] = field(default_factory=dict)
+    trace_id: Optional[str] = None
 
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -66,6 +83,10 @@ class Resolution:
             "fingerprint": self.fingerprint.to_json(),
             "provenance": self.provenance,
         }
+        if self.phase_us:
+            out["phase_us"] = self.phase_us
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         if self.record is not None:
             out["key"] = self.record["key"]
             out["ops"] = self.record["ops"]
@@ -187,28 +208,52 @@ class Resolver:
             return None
 
     # -- tiers ---------------------------------------------------------------
-    def _try_exact(self, req, fp: WorkloadFingerprint) -> Optional[Resolution]:
+    def _try_exact(self, req, fp: WorkloadFingerprint,
+                   phases: Dict[str, float]) -> Optional[Resolution]:
         reg = get_metrics()
-        if self.serve_cache:
-            hit = self._exact_cache.get(fp.exact_digest)
-            if hit is not None and hit[0].get("flags", {}).get("unsound"):
-                # belt-and-braces behind the generation check: a record
-                # flagged between the generation bump and this probe (or
-                # by a caller holding the same dict) must never be served
-                self._exact_cache.pop(fp.exact_digest, None)
-                hit = None
-            if hit is not None:
-                # the hot path: one dict probe, zero materializations,
-                # zero verifier invocations — the record was admitted
-                # (verified + sealed) when it entered the cache
-                rec, seq, prov = hit
-                reg.counter("serve.exact_cache.hits").inc()
-                return Resolution(tier="exact", fingerprint=fp, record=rec,
-                                  sequence=seq,
-                                  pct50_us=rec.get("pct50_us"),
-                                  vs_naive=rec.get("vs_naive"),
-                                  provenance=dict(prov, cache_hit=True))
+        t0 = time.perf_counter()
+        with get_tracer().span("serve.cache_probe") as psp:
+            if self.serve_cache:
+                hit = self._exact_cache.get(fp.exact_digest)
+                if hit is not None and \
+                        hit[0].get("flags", {}).get("unsound"):
+                    # belt-and-braces behind the generation check: a
+                    # record flagged between the generation bump and this
+                    # probe (or by a caller holding the same dict) must
+                    # never be served
+                    self._exact_cache.pop(fp.exact_digest, None)
+                    hit = None
+                if hit is not None:
+                    # the hot path: one dict probe, zero
+                    # materializations, zero verifier invocations — the
+                    # record was admitted (verified + sealed) when it
+                    # entered the cache
+                    rec, seq, prov = hit
+                    phases["cache_probe"] = round(
+                        (time.perf_counter() - t0) * 1e6, 2)
+                    psp.set("hit", True)
+                    reg.counter("serve.exact_cache.hits").inc()
+                    return Resolution(
+                        tier="exact", fingerprint=fp, record=rec,
+                        sequence=seq, pct50_us=rec.get("pct50_us"),
+                        vs_naive=rec.get("vs_naive"),
+                        provenance=dict(prov, cache_hit=True))
+            psp.set("hit", False)
+        phases["cache_probe"] = round((time.perf_counter() - t0) * 1e6, 2)
+        t_walk = time.perf_counter()
         records = self.store.exact_records(fp.exact_digest)
+        # the walk phase covers everything past the probe (store listing,
+        # materialization, verification fallback) — the cold/near paths
+        # overwrite nothing, so an exact miss still reports what the
+        # exact tier spent before falling through
+        try:
+            return self._walk_exact(req, fp, records, reg)
+        finally:
+            phases["store_walk"] = round(
+                (time.perf_counter() - t_walk) * 1e6, 2)
+
+    def _walk_exact(self, req, fp: WorkloadFingerprint,
+                    records, reg) -> Optional[Resolution]:
         if not records:
             return None
         if self.serve_cache:
@@ -341,7 +386,8 @@ class Resolver:
                 # identical work item each time (same reasoning as
                 # flag()'s unchanged-short-circuit above)
                 self.queue.ensure(fp, self._request_payload(req),
-                                  reason="refine-near-miss")
+                                  reason="refine-near-miss",
+                                  trace=obs_context.current())
             prov = {
                 "verified": verified,
                 "was_predicted": True,
@@ -360,8 +406,12 @@ class Resolver:
     def _cold(self, req, fp: WorkloadFingerprint) -> Resolution:
         path = None
         if self.queue is not None:
+            # the ambient trace context rides the work-item envelope:
+            # the daemon drain this item causes is linkable back to the
+            # query that caused it (obs/context.py)
             path = self.queue.ensure(fp, self._request_payload(req),
-                                     reason="cold")
+                                     reason="cold",
+                                     trace=obs_context.current())
         return Resolution(
             tier="cold", fingerprint=fp, work_item=path,
             provenance={"was_predicted": False, "compiles": 0,
@@ -375,7 +425,14 @@ class Resolver:
     # -- entry ---------------------------------------------------------------
     def resolve(self, req) -> Resolution:
         """Resolve a :class:`~tenzing_tpu.bench.driver.DriverRequest`
-        through the tiers."""
+        through the tiers, under the ambient trace context (one is
+        minted here when the caller arrived without one — the resolver
+        is the ingress of record for non-listen paths)."""
+        ctx = obs_context.current() or obs_context.new_trace()
+        with obs_context.use(ctx):
+            return self._resolve(req, ctx)
+
+    def _resolve(self, req, ctx) -> Resolution:
         reg = get_metrics()
         tr = get_tracer()
         t0 = time.perf_counter()
@@ -386,14 +443,38 @@ class Resolver:
             # stale answer would outlive the better record that beat it
             self._exact_cache.clear()
             self._exact_cache_gen = gen
-        fp = fingerprint_of(req)
-        with tr.span("serve.query", workload=fp.workload,
-                     exact=fp.exact_digest, bucket=fp.bucket_digest) as sp:
-            res = (self._try_exact(req, fp)
+        phases: Dict[str, float] = {}
+        with tr.span("serve.query") as sp:
+            # fingerprint canonicalization is the first per-hit phase the
+            # ROADMAP's tens-of-µs item profiles — timed always (two
+            # perf_counter reads), sub-spanned only when tracing is on
+            t_fp = time.perf_counter()
+            if tr.enabled:
+                with tr.span("serve.fingerprint"):
+                    fp = fingerprint_of(req)
+            else:
+                fp = fingerprint_of(req)
+            phases["fingerprint"] = round(
+                (time.perf_counter() - t_fp) * 1e6, 2)
+            sp.set("workload", fp.workload)
+            sp.set("exact", fp.exact_digest)
+            sp.set("bucket", fp.bucket_digest)
+            res = (self._try_exact(req, fp, phases)
                    or self._try_near(req, fp)
                    or self._cold(req, fp))
             sp.set("tier", res.tier)
+        res.phase_us = phases
+        res.trace_id = ctx.trace_id
         reg.counter(f"serve.{res.tier}").inc()
-        reg.histogram("serve.resolve_us").observe(
-            (time.perf_counter() - t0) * 1e6)
+        dt_us = (time.perf_counter() - t0) * 1e6
+        # windowed retention (obs/metrics.py): a live SLO block must
+        # read the pct99 of CURRENT traffic — first-N retention would
+        # freeze the series at whatever the process saw before the cap
+        # filled and hide every post-warm-up regression
+        reg.histogram("serve.resolve_us", window=True).observe(dt_us)
+        # the per-tier series the SLO block and the follow view read:
+        # exact-tier pct99 mixed with cold-tier enqueue latency would
+        # steer the tens-of-µs target with the wrong number
+        reg.histogram(f"serve.resolve_us.{res.tier}",
+                      window=True).observe(dt_us)
         return res

@@ -485,28 +485,35 @@ class SegmentedStore(ScheduleStore):
         the last flush, never to the corpus (module docstring)."""
         if self.dir is None:
             return
-        os.makedirs(self.dir, exist_ok=True)
-        by_bucket: Dict[str, List[Record]] = {}
-        for exact, key in self._dirty:
-            rec = self.entries.get(exact, {}).get(key)
-            if rec is not None:
-                by_bucket.setdefault(rec.get("bucket") or "unbucketed",
-                                     []).append(rec)
-        added: Dict[str, Dict[str, Any]] = {}
-        for bucket in sorted(by_bucket):
-            name, meta = self._publish_segment(bucket, by_bucket[bucket],
-                                               source="flush")
-            added[name] = meta
-        if added or not os.path.exists(self.manifest_path):
+        # the "store merge" leg of a request's cross-process trace: a
+        # drain daemon flushes under the work item's ambient context, so
+        # this span carries the originating query's trace_id
+        with get_tracer().span("serve.store.flush",
+                               backend="segmented") as sp:
+            os.makedirs(self.dir, exist_ok=True)
+            by_bucket: Dict[str, List[Record]] = {}
+            for exact, key in self._dirty:
+                rec = self.entries.get(exact, {}).get(key)
+                if rec is not None:
+                    by_bucket.setdefault(rec.get("bucket") or "unbucketed",
+                                         []).append(rec)
+            added: Dict[str, Dict[str, Any]] = {}
+            for bucket in sorted(by_bucket):
+                name, meta = self._publish_segment(
+                    bucket, by_bucket[bucket], source="flush")
+                added[name] = meta
+            sp.set("segments", len(added))
+            sp.set("dirty_records", sum(len(v) for v in by_bucket.values()))
+            if added or not os.path.exists(self.manifest_path):
 
-            def mutate(doc):
-                doc["segments"].update(added)
-                return doc
+                def mutate(doc):
+                    doc["segments"].update(added)
+                    return doc
 
-            self._mutate_manifest(mutate)
-            for name in added:
-                self.segment_info[name]["listed"] = True
-        self._dirty.clear()
+                self._mutate_manifest(mutate)
+                for name in added:
+                    self.segment_info[name]["listed"] = True
+            self._dirty.clear()
         get_metrics().counter("serve.store.flushed").inc()
 
     # -- stats ---------------------------------------------------------------

@@ -359,33 +359,40 @@ class ScheduleStore:
         non-simultaneous) writers."""
         if self.path is None:
             return
-        # the CLI promises "created on first flush": the directory must
-        # exist before the .lock sidecar opens (atomic_dump_json would
-        # create it, but the lock comes first)
-        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
-                    exist_ok=True)
-        try:
-            import fcntl
-        except ImportError:  # pragma: no cover — non-POSIX fallback
-            fcntl = None
-        lock_f = None
-        try:
-            if fcntl is not None:
-                lock_f = open(self.path + ".lock", "w")
-                fcntl.flock(lock_f, fcntl.LOCK_EX)
-            if os.path.exists(self.path):
-                # uncounted throwaway read + plain re-puts: this is
-                # flush bookkeeping, not a real load or merge — the
-                # documented store-economics counters must not grow
-                # with flush count
-                disk = ScheduleStore(self.path, tenant=self.tenant,
-                                     log=self._log, _count_metrics=False)
-                for rec in disk.records():
-                    self._put(dict(rec))
-            atomic_dump_json(self.path, self.to_json(), prefix=".store.")
-        finally:
-            if lock_f is not None:
-                lock_f.close()  # releases the flock
+        # the flush span is the "store merge" leg of a request's
+        # cross-process trace: under a drain's ambient context it stamps
+        # the trace_id that started the cold query (obs/context.py)
+        with get_tracer().span("serve.store.flush", backend="monolithic",
+                               records=len(self)):
+            # the CLI promises "created on first flush": the directory
+            # must exist before the .lock sidecar opens (atomic_dump_json
+            # would create it, but the lock comes first)
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                        exist_ok=True)
+            try:
+                import fcntl
+            except ImportError:  # pragma: no cover — non-POSIX fallback
+                fcntl = None
+            lock_f = None
+            try:
+                if fcntl is not None:
+                    lock_f = open(self.path + ".lock", "w")
+                    fcntl.flock(lock_f, fcntl.LOCK_EX)
+                if os.path.exists(self.path):
+                    # uncounted throwaway read + plain re-puts: this is
+                    # flush bookkeeping, not a real load or merge — the
+                    # documented store-economics counters must not grow
+                    # with flush count
+                    disk = ScheduleStore(self.path, tenant=self.tenant,
+                                         log=self._log,
+                                         _count_metrics=False)
+                    for rec in disk.records():
+                        self._put(dict(rec))
+                atomic_dump_json(self.path, self.to_json(),
+                                 prefix=".store.")
+            finally:
+                if lock_f is not None:
+                    lock_f.close()  # releases the flock
         get_metrics().counter("serve.store.flushed").inc()
 
     def stats(self) -> Dict[str, Any]:
@@ -499,12 +506,15 @@ class WorkQueue:
         return stem.split("-", 1)[1] if "-" in stem else stem
 
     def ensure(self, fingerprint, request: Dict[str, Any],
-               reason: str) -> str:
+               reason: str, trace=None) -> str:
         """:meth:`enqueue` only when no valid item already exists for
         this fingerprint — the hot-path variant (the near tier
         re-resolves a popular fingerprint at fleet rates, and an
         identical re-write would pay json+sha256+fsync+rename per
-        request); an existing-but-unreadable item IS rewritten."""
+        request); an existing-but-unreadable item IS rewritten.  The
+        first enqueuer's trace context sticks: re-asserting queries do
+        not rewrite the item, so the drain links back to the query that
+        actually created the work."""
         path = self.path_for(fingerprint.exact_digest)
         if os.path.exists(path):
             try:
@@ -512,19 +522,27 @@ class WorkQueue:
                 return path
             except Exception:
                 pass  # torn/corrupt item: re-assert it below
-        return self.enqueue(fingerprint, request, reason)
+        return self.enqueue(fingerprint, request, reason, trace=trace)
 
     def enqueue(self, fingerprint, request: Dict[str, Any],
-                reason: str) -> str:
+                reason: str, trace=None) -> str:
+        """``trace`` is an :class:`~tenzing_tpu.obs.context.TraceContext`
+        (or None): stamped into the checkpoint envelope so the drain —
+        possibly days later, on another host, after the enqueuing
+        process died — still runs under the originating query's
+        trace_id (docs/observability.md "Fleet telemetry plane")."""
         os.makedirs(self.dir, exist_ok=True)
         path = self.path_for(fingerprint.exact_digest)
-        atomic_write_json(path, {
+        doc = {
             "kind": "search_request",
             "reason": reason,
             "fingerprint": fingerprint.to_json(),
             "request": request,
             "checkpoint": self.checkpoint_dir_for(fingerprint.exact_digest),
-        })
+        }
+        if trace is not None:
+            doc["trace"] = trace.to_json()
+        atomic_write_json(path, doc)
         get_metrics().counter("serve.queue.enqueued").inc()
         tr = get_tracer()
         if tr.enabled:

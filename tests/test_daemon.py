@@ -352,7 +352,15 @@ def test_chaos_sigkill_mid_item_reclaim_resume_exactly_once(tmp_path):
     transient+hang injection; a restarted daemon reclaims the expired
     lease and completes via checkpoint resume — journaled measurements
     replayed (the driver's ``resume:`` line + ``fault.resumed``), store
-    warmed, re-query exact — the item's effect lands exactly once."""
+    warmed, re-query exact — the item's effect lands exactly once.
+
+    Telemetry-plane acceptance rides along (ISSUE 12): the work item is
+    enqueued under a trace context, and the SUCCESSOR daemon — which
+    never saw the originating process — resumes the drain under the
+    SAME trace_id (re-read from the envelope), stamping it into its own
+    bundle and its drain child's."""
+    from tenzing_tpu.obs.context import new_trace
+
     qdir = str(tmp_path / "q")
     store = str(tmp_path / "store.json")
     q = WorkQueue(qdir)
@@ -361,7 +369,8 @@ def test_chaos_sigkill_mid_item_reclaim_resume_exactly_once(tmp_path):
                         inject_faults="transient:0.3:7,hang:0.05:11",
                         inject_hang_secs=1.0, measure_timeout=300.0)
     fp = fingerprint_of(req)
-    q.enqueue(fp, req.to_json(), reason="cold")
+    ctx = new_trace()
+    q.enqueue(fp, req.to_json(), reason="cold", trace=ctx)
     exact = fp.exact_digest
     ckpt = q.checkpoint_dir_for(exact)
 
@@ -383,9 +392,11 @@ def test_chaos_sigkill_mid_item_reclaim_resume_exactly_once(tmp_path):
     assert len(q) == 1, "the item must survive the kill"
     time.sleep(2.2)  # age the lease past the TTL
 
+    daemon_bundle = str(tmp_path / "daemon.jsonl")
     r = subprocess.run(
         [sys.executable, "-m", "tenzing_tpu.serve.daemon",
-         "--queue", qdir, "--store", store, "--once", "--lease-ttl", "2"],
+         "--queue", qdir, "--store", store, "--once", "--lease-ttl", "2",
+         "--trace-out", daemon_bundle],
         cwd=REPO, capture_output=True, text=True, timeout=500)
     assert r.returncode == 0, r.stderr[-2000:]
     summary = json.loads(r.stdout.splitlines()[-1])
@@ -413,6 +424,33 @@ def test_chaos_sigkill_mid_item_reclaim_resume_exactly_once(tmp_path):
     res = Resolver(st).resolve(req)
     assert res.tier == "exact"
     assert res.provenance["compiles"] == 0
+
+    # the successor — a fresh process that never met the enqueuer —
+    # drained under the envelope's trace_id: its own bundle (daemon.drain
+    # + the store merge) and its drain child's both carry it, and the
+    # stitcher ties the two processes into one trace
+    from tenzing_tpu.obs.export import read_jsonl, stitch
+
+    drain_spans = [rec for rec in read_jsonl(daemon_bundle)
+                   if rec.get("name") == "daemon.drain"]
+    assert drain_spans, "successor daemon recorded no drain span"
+    assert drain_spans[0]["attrs"]["trace_id"] == ctx.trace_id
+    merge_spans = [rec for rec in read_jsonl(daemon_bundle)
+                   if rec.get("name") == "serve.store.flush"]
+    assert merge_spans
+    assert merge_spans[0]["attrs"]["trace_id"] == ctx.trace_id
+    child_bundle = os.path.join(ckpt, "trace", "trace.jsonl")
+    assert os.path.exists(child_bundle), \
+        "the traced daemon's child must archive its own bundle"
+    child_traced = [rec for rec in read_jsonl(child_bundle)
+                    if (rec.get("attrs") or {}).get("trace_id")
+                    == ctx.trace_id]
+    assert child_traced, "child spans must carry the item's trace_id"
+    merged = stitch([daemon_bundle, child_bundle])
+    t = merged["traces"][ctx.trace_id]
+    assert t["n_processes"] == 2
+    assert "daemon.drain" in t["names"]
+    assert "serve.store.flush" in t["names"]
 
 
 def test_malformed_item_poisons_through_the_real_child(tmp_path):
@@ -444,3 +482,91 @@ def test_malformed_item_poisons_through_the_real_child(tmp_path):
     assert poison["attempts"][-1]["error_class"] == "deterministic"
     assert "bogus" in poison["attempts"][-1]["message"]
     assert len(q) == 0
+
+
+# -- fleet telemetry plane (ISSUE 12): trace-context propagation -------------
+
+def test_drain_runs_under_item_trace_context(tmp_path):
+    """The trace context stamped into the work-item envelope at enqueue
+    is ambient for the whole drain: the daemon.drain span AND the store
+    merge's serve.warm / serve.store.flush spans carry its trace_id."""
+    from tenzing_tpu.obs.context import new_trace
+    from tenzing_tpu.obs.tracer import Tracer, set_tracer
+
+    qdir = str(tmp_path / "q")
+    q = WorkQueue(qdir)
+    req = DriverRequest(workload="spmv", m=512)
+    fp = fingerprint_of(req)
+    ctx = new_trace()
+    q.enqueue(fp, req.to_json(), reason="cold", trace=ctx)
+    item = read_checked_json(q.path_for(fp.exact_digest))
+    assert item["trace"] == ctx.to_json()
+
+    tr = Tracer(enabled=True)
+    prev = set_tracer(tr)
+    try:
+        d = DrainDaemon(_opts(tmp_path),
+                        runner=lambda p, pl, t: _ok_verdict(),
+                        log=lambda m: None)
+        s = d.run()
+        assert s["counters"]["completed"] == 1
+    finally:
+        set_tracer(prev)
+    spans = {s.name: s for s in tr.spans()}
+    assert spans["daemon.drain"].attrs["trace_id"] == ctx.trace_id
+    assert spans["serve.warm"].attrs["trace_id"] == ctx.trace_id
+    assert spans["serve.store.flush"].attrs["trace_id"] == ctx.trace_id
+    # an item enqueued WITHOUT a trace drains unstamped (no leakage of
+    # the previous item's context through the process default)
+    q.enqueue(fp, req.to_json(), reason="cold")
+    tr2 = Tracer(enabled=True)
+    prev = set_tracer(tr2)
+    try:
+        DrainDaemon(_opts(tmp_path),
+                    runner=lambda p, pl, t: _ok_verdict(),
+                    log=lambda m: None).run()
+    finally:
+        set_tracer(prev)
+    drain2 = [s for s in tr2.spans() if s.name == "daemon.drain"]
+    assert drain2 and "trace_id" not in drain2[0].attrs
+
+
+def test_exec_item_adopts_envelope_then_env_and_restores(tmp_path,
+                                                         monkeypatch):
+    """exec_item prefers the envelope's trace (the SIGKILL-survivable
+    copy), falls back to the env var, and restores the process default
+    on the way out (the in-process drain loop must not leak item N's
+    context into item N+1)."""
+    from tenzing_tpu.obs import context as obs_context
+    from tenzing_tpu.obs.context import TRACE_ENV, new_trace
+    from tenzing_tpu.serve import daemon as daemon_mod
+
+    seen = {}
+
+    def fake_run(req):
+        seen["ctx"] = obs_context.current()
+
+        class R:
+            verdict = {"metric": "m", "value": 1.0}
+
+        return R()
+
+    import tenzing_tpu.bench.driver as driver_mod
+
+    monkeypatch.setattr(driver_mod, "run", fake_run)
+    q = WorkQueue(str(tmp_path / "q"))
+    req = DriverRequest(workload="spmv", m=512)
+    fp = fingerprint_of(req)
+    env_ctx = new_trace()
+    monkeypatch.setenv(TRACE_ENV, env_ctx.to_env_value())
+    # envelope wins over env
+    envelope_ctx = new_trace()
+    path = q.enqueue(fp, req.to_json(), reason="cold", trace=envelope_ctx)
+    daemon_mod.exec_item(read_checked_json(path), path)
+    assert seen["ctx"].trace_id == envelope_ctx.trace_id
+    assert obs_context.current() is None  # restored
+    # env is the fallback when the envelope has no trace
+    path = q.enqueue(fp, req.to_json(), reason="cold")
+    daemon_mod.exec_item(read_checked_json(path), path)
+    assert seen["ctx"].trace_id == env_ctx.trace_id
+    assert obs_context.current() is None

@@ -1,0 +1,461 @@
+"""Fleet telemetry plane (ISSUE 12): cross-process trace context
+(mint / envelope / env round-trips, ambient span+event stamping,
+process-default inheritance), bounded tracer retention with drop
+counters, the cross-process bundle stitcher, metric-snapshot ring +
+SLO block, the SERVE_BENCH-family regression check, and the --follow
+fleet view's renderer."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tenzing_tpu.obs import context as obs_context
+from tenzing_tpu.obs.context import TRACE_ENV, TraceContext, new_trace
+from tenzing_tpu.obs.export import stitch, write_jsonl
+from tenzing_tpu.obs.metrics import (
+    MetricsRegistry,
+    MetricsSnapshotWriter,
+    SloConfig,
+    baseline_pct99_from,
+    get_metrics,
+    latest_snapshots,
+    set_metrics,
+)
+from tenzing_tpu.obs.report import check_serve_regression, fleet_lines
+from tenzing_tpu.obs.tracer import Tracer, set_tracer
+
+
+@pytest.fixture
+def tracer():
+    tr = Tracer(enabled=True)
+    prev = set_tracer(tr)
+    try:
+        yield tr
+    finally:
+        set_tracer(prev)
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_metrics(reg)
+    try:
+        yield reg
+    finally:
+        set_metrics(prev)
+
+
+# -- context minting / round-trips ------------------------------------------
+
+def test_mint_and_roundtrips():
+    ctx = new_trace()
+    assert len(ctx.trace_id) == 16 and len(ctx.span_id) == 16
+    assert ctx.trace_id != new_trace().trace_id  # urandom, not seeded
+    # envelope round-trip
+    back = obs_context.from_json(ctx.to_json())
+    assert back == ctx
+    # env round-trip
+    env = obs_context.to_env({}, ctx)
+    assert env[TRACE_ENV] == ctx.to_env_value()
+    assert obs_context.from_env(env) == ctx
+    # a child shares the trace, renames the hop
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+
+
+def test_malformed_inputs_never_raise():
+    assert obs_context.from_json(None) is None
+    assert obs_context.from_json("nope") is None
+    assert obs_context.from_json({}) is None
+    assert obs_context.from_json({"trace_id": ""}) is None
+    # a missing span_id degrades, never fails (torn envelope key)
+    assert obs_context.from_json({"trace_id": "abc"}).span_id == "0"
+    assert obs_context.from_env({}) is None
+    assert obs_context.from_env({TRACE_ENV: ""}) is None
+    assert obs_context.to_env({}, None) == {}
+
+
+# -- ambient stamping -------------------------------------------------------
+
+def test_spans_and_events_stamp_ambient_context(tracer):
+    ctx = new_trace()
+    with obs_context.use(ctx):
+        with tracer.span("outer", a=1):
+            with tracer.span("inner"):
+                pass
+        tracer.event("ev", n=2)
+    spans = {s.name: s for s in tracer.spans()}
+    # the root span carries trace_id + the remote parent hop; nested
+    # spans carry only trace_id (their parent chain is in-process)
+    assert spans["outer"].attrs["trace_id"] == ctx.trace_id
+    assert spans["outer"].attrs["parent_span"] == ctx.span_id
+    assert spans["outer"].attrs["a"] == 1
+    assert spans["inner"].attrs == {"trace_id": ctx.trace_id}
+    assert tracer.events()[0].attrs == {"trace_id": ctx.trace_id, "n": 2}
+
+
+def test_no_context_means_no_stamp(tracer):
+    with tracer.span("s", k="v"):
+        pass
+    tracer.event("e")
+    assert tracer.spans()[0].attrs == {"k": "v"}
+    assert tracer.events()[0].attrs == {}
+
+
+def test_use_none_is_noop(tracer):
+    with obs_context.use(None):
+        assert obs_context.current() is None
+        with tracer.span("s"):
+            pass
+    assert "trace_id" not in tracer.spans()[0].attrs
+
+
+def test_process_default_inherited_by_worker_threads(tracer):
+    ctx = new_trace()
+    prev = obs_context.set_process_default(ctx)
+    try:
+        def worker():
+            with tracer.span("w"):
+                pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert tracer.spans()[0].attrs["trace_id"] == ctx.trace_id
+        # a thread-local use() wins over the process default
+        other = new_trace()
+        with obs_context.use(other):
+            assert obs_context.current() == other
+        assert obs_context.current() == ctx
+    finally:
+        obs_context.set_process_default(prev)
+    assert obs_context.current() is prev
+
+
+# -- bounded tracer retention -----------------------------------------------
+
+def test_span_event_rings_evict_oldest_and_count_drops():
+    tr = Tracer(enabled=True, max_spans=3, max_events=2)
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+        tr.event(f"e{i}")
+    assert [s.name for s in tr.spans()] == ["s2", "s3", "s4"]
+    assert [e.name for e in tr.events()] == ["e3", "e4"]
+    ret = tr.retention()
+    assert ret["dropped_spans"] == 2 and ret["dropped_events"] == 3
+    assert ret["spans"] == 3 and ret["max_spans"] == 3
+    tr.clear()
+    assert tr.retention()["dropped_spans"] == 0
+
+
+def test_snapshot_prunes_dead_thread_state():
+    tr = Tracer(enabled=True)
+    # overlap the threads (barrier) so the OS cannot recycle idents —
+    # four genuinely distinct threads leave four stack/tid entries
+    barrier = threading.Barrier(4)
+
+    def worker():
+        with tr.span("w"):
+            barrier.wait(timeout=10)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tr._open_stacks) == 4
+    assert len({s.tid for s in tr.spans()}) == 4
+    tr.snapshot()
+    # dead threads' empty stacks and tid mappings are gone; the live
+    # (current) thread's state survives only if it recorded anything
+    alive = {t.ident for t in threading.enumerate()}
+    assert set(tr._open_stacks) <= alive
+    assert set(tr._tids) <= alive
+    # the recorded spans themselves are untouched
+    assert sum(1 for s in tr.spans() if s.name == "w") == 4
+
+
+# -- stitcher ---------------------------------------------------------------
+
+def test_stitch_groups_bundles_by_trace_id(tmp_path):
+    ctx = new_trace()
+    bundles = []
+    for name, spans in (("ingress", ["serve.query"]),
+                        ("daemon", ["daemon.drain", "serve.store.flush"])):
+        tr = Tracer(enabled=True)
+        with obs_context.use(ctx):
+            for s in spans:
+                with tr.span(s):
+                    pass
+        # plus one context-less span that must NOT join the trace
+        with tr.span("background"):
+            pass
+        p = str(tmp_path / f"{name}.jsonl")
+        write_jsonl(tr, p)
+        bundles.append(p)
+    out = str(tmp_path / "merged.json")
+    summary = stitch(bundles, out_path=out)
+    t = summary["traces"][ctx.trace_id]
+    assert t["n_processes"] == 2
+    assert t["processes"] == ["daemon.jsonl", "ingress.jsonl"]
+    assert set(t["names"]) == {"serve.query", "daemon.drain",
+                               "serve.store.flush"}
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    # each bundle is its own Perfetto process, named
+    names = {e["args"]["name"] for e in evs if e.get("ph") == "M"
+             and e["name"] == "process_name"}
+    assert {"ingress.jsonl", "daemon.jsonl"} <= names
+    pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+    assert len(pids) == 2
+    # flow arrows tie the trace across processes (s first, f last)
+    flows = [e for e in evs if e.get("cat") == "trace"
+             and e.get("id") == ctx.trace_id]
+    assert [f["ph"] for f in flows].count("s") == 1
+    assert [f["ph"] for f in flows].count("f") == 1
+    assert len(flows) == 3  # one anchor per trace-stamped span
+
+
+def test_stitch_dedups_colliding_basenames(tmp_path):
+    ctx = new_trace()
+    paths = []
+    for sub in ("a", "b"):
+        d = tmp_path / sub
+        d.mkdir()
+        tr = Tracer(enabled=True)
+        with obs_context.use(ctx):
+            with tr.span("x"):
+                pass
+        p = str(d / "trace.jsonl")
+        write_jsonl(tr, p)
+        paths.append(p)
+    summary = stitch(paths)
+    assert summary["traces"][ctx.trace_id]["n_processes"] == 2
+    assert sorted(summary["bundles"]) == ["a/trace.jsonl", "b/trace.jsonl"]
+
+
+# -- metric snapshots + SLO -------------------------------------------------
+
+def test_snapshot_ring_bound_and_latest(tmp_path, registry, tracer):
+    registry.counter("c").inc(3)
+    w = MetricsSnapshotWriter(str(tmp_path), "own", ring=3,
+                              registry=registry, tracer=tracer)
+    for _ in range(7):
+        w.write(state="serving", extra={"queue_depth": 1})
+    files = sorted(p for p in os.listdir(tmp_path)
+                   if p.startswith("metrics-own-"))
+    assert len(files) == 3  # the ring bound, not 7 files
+    latest = latest_snapshots(str(tmp_path))
+    assert set(latest) == {"own"}
+    doc = latest["own"]
+    assert doc["seq"] == 6 and doc["state"] == "serving"
+    assert doc["metrics"]["counters"]["c"] == 3
+    assert doc["queue_depth"] == 1
+    assert "dropped_spans" in doc["tracer"]
+
+
+def test_slo_block_target_and_burn(registry):
+    hist = registry.histogram("serve.resolve_us.exact")
+    for v in (100.0, 120.0, 400.0):
+        hist.observe(v)
+    slo = SloConfig(target_us=500.0, baseline_pct99_us=300.0)
+    b = slo.block(registry)
+    assert b["within_target"] is True
+    assert b["pct99_us"] == 400.0
+    assert b["burn"] == "degrading"  # 400/300 > 1.05
+    assert b["vs_baseline"] == round(400.0 / 300.0, 4)
+    improving = SloConfig(target_us=200.0, baseline_pct99_us=10_000.0)
+    b2 = improving.block(registry)
+    assert b2["within_target"] is False and b2["burn"] == "improving"
+    flat = SloConfig(baseline_pct99_us=401.0)
+    assert flat.block(registry)["burn"] == "flat"
+    # an empty registry yields a block with no verdicts, never a crash
+    empty = SloConfig(target_us=1.0).block(MetricsRegistry())
+    assert empty["pct99_us"] is None and "within_target" not in empty
+
+
+def test_baseline_pct99_from_replay_doc(tmp_path):
+    p = tmp_path / "SERVE_BENCH_rX.json"
+    p.write_text(json.dumps({
+        "kind": "serve_trace_replay",
+        "segmented": {"resolve_us": {"exact": {"pct99_us": 261.0}}}}))
+    assert baseline_pct99_from(str(p)) == 261.0
+    assert baseline_pct99_from(str(tmp_path / "missing.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert baseline_pct99_from(str(bad)) is None
+
+
+# -- SERVE_BENCH-family regression check ------------------------------------
+
+def _replay_doc(pct99, verifier=0, shed=0, samples=None):
+    if samples is None:
+        import random
+
+        rng = random.Random(0)  # i.i.d.-looking: passes the runs test
+        samples = [pct99 + rng.uniform(-5, 5) for _ in range(64)]
+    return {
+        "kind": "serve_trace_replay",
+        "segmented": {
+            "resolve_us": {"exact": {"pct99_us": pct99, "count": 100}},
+            "verifier_calls": verifier,
+            "shed": shed,
+            "exact_samples_us": samples,
+        },
+    }
+
+
+def test_check_serve_regression_ok_and_flagged():
+    base = _replay_doc(260.0)
+    ok = check_serve_regression(_replay_doc(280.0), base, tol=0.25)
+    assert ok["verdict"] == "ok" and not ok["reasons"]
+    bad = check_serve_regression(_replay_doc(400.0), base, tol=0.25)
+    assert bad["verdict"] == "regression"
+    assert any("pct99" in r for r in bad["reasons"])
+    # design-guarantee secondaries: verifier calls / shed reappearing
+    ver = check_serve_regression(_replay_doc(261.0, verifier=3), base,
+                                 tol=0.25)
+    assert ver["verdict"] == "regression"
+    assert any("verifier" in r for r in ver["reasons"])
+    sh = check_serve_regression(_replay_doc(261.0, shed=5), base, tol=0.25)
+    assert any("shed" in r for r in sh["reasons"])
+
+
+def test_check_serve_regression_noise_downgrades():
+    base = _replay_doc(260.0)
+    # a monotone ramp fails the runs test: the would-be regression is
+    # inconclusive (drift/interference), same semantics as the bench gate
+    drifty = _replay_doc(900.0, samples=[100.0 + 10 * i for i in range(64)])
+    v = check_serve_regression(drifty, base, tol=0.25)
+    assert v["verdict"] == "inconclusive"
+    assert "runs_test_z" in v["checks"]
+
+
+# -- follow view renderer ---------------------------------------------------
+
+def test_fleet_lines_renders_serve_and_daemon_state(tmp_path, registry,
+                                                    tracer):
+    store = tmp_path / "store"
+    queue = tmp_path / "queue"
+    store.mkdir()
+    queue.mkdir()
+    now = time.time()
+    (store / "status-svc.json").write_text(json.dumps({
+        "kind": "serve_loop", "owner": "svc", "state": "serving",
+        "heartbeat_at": now, "queue_depth": 2, "in_flight": 1,
+        "counters": {"served_exact": 8, "served_near": 1,
+                     "served_cold": 1, "shed": 3, "timeouts": 0}}))
+    registry.gauge("serve.queue_age_s").set(1.5)
+    registry.histogram("serve.resolve_us.exact").observe(42.0)
+    MetricsSnapshotWriter(str(store), "svc", registry=registry,
+                          tracer=tracer,
+                          slo=SloConfig(target_us=100.0)).write()
+    (queue / "status-d1.json").write_text(json.dumps({
+        "owner": "d1", "state": "draining", "heartbeat_at": now,
+        "item": {"exact": "abcd" * 4, "since": now - 5},
+        "counters": {"claimed": 3, "completed": 2, "retried": 1,
+                     "poisoned": 0}}))
+    text = "\n".join(fleet_lines([str(store)], [str(queue)]))
+    assert "serve  svc: serving" in text
+    assert "mix exact:8 (80%)" in text
+    assert "slo:" in text and "target 100us [OK]" in text
+    assert "daemon d1: draining" in text
+    assert "claimed 3, completed 2" in text
+    assert "queue " in text and "depth 0" in text
+    # missing dirs are reported, not created
+    text2 = "\n".join(fleet_lines([], [str(tmp_path / "nope")]))
+    assert "missing directory" in text2
+    assert not (tmp_path / "nope").exists()
+
+
+# -- review-hardening regressions ---------------------------------------------
+
+def test_windowed_histogram_tracks_recent_not_first(registry):
+    """An SLO block must read CURRENT traffic: windowed retention keeps
+    the most recent max_raw observations (first-N retention would
+    freeze the pct99 at pre-warm-up traffic forever)."""
+    from tenzing_tpu.obs.metrics import Histogram
+
+    h = registry.histogram("serve.resolve_us.exact", max_raw=8,
+                           window=True)
+    for v in [10.0] * 8 + [1000.0] * 8:  # regression after the cap fills
+        h.observe(v)
+    s = h.summary()
+    assert s["window"] is True and s["raw_retained"] == 8
+    assert s["count"] == 16
+    assert s["p99"] == 1000.0, "windowed pct99 must see the regression"
+    slo = SloConfig(target_us=100.0, histogram="serve.resolve_us.exact")
+    assert slo.block(registry)["within_target"] is False
+    # plain histograms keep the documented prefix semantics
+    plain = Histogram("x", max_raw=8)
+    for v in [10.0] * 8 + [1000.0] * 8:
+        plain.observe(v)
+    sp = plain.summary()
+    assert sp["truncated"] is True and sp["p99"] == 10.0
+
+
+def test_stitch_labels_unique_for_identical_ckpt_layout(tmp_path):
+    """Every drain child writes ckpt-<exact>/trace/trace.jsonl — labels
+    must grow path components until the processes separate, or two
+    children merge into one Perfetto row and n_processes undercounts."""
+    ctx = new_trace()
+    paths = []
+    for exact in ("ckpt-aaaa", "ckpt-bbbb"):
+        d = tmp_path / exact / "trace"
+        d.mkdir(parents=True)
+        tr = Tracer(enabled=True)
+        with obs_context.use(ctx):
+            with tr.span("bench.benchmark"):
+                pass
+        p = str(d / "trace.jsonl")
+        write_jsonl(tr, p)
+        paths.append(p)
+    summary = stitch(paths)
+    assert summary["traces"][ctx.trace_id]["n_processes"] == 2
+    assert sorted(summary["bundles"]) == [
+        "ckpt-aaaa/trace/trace.jsonl", "ckpt-bbbb/trace/trace.jsonl"]
+
+
+def test_mixed_family_regression_check_is_a_usage_error(tmp_path):
+    """--check SERVE_BENCH vs --baseline BENCH (a mis-wired gate) must
+    exit 2, not vacuously pass with empty checks."""
+    from tenzing_tpu.obs.report import main as report_main
+
+    serve_doc = tmp_path / "serve.json"
+    serve_doc.write_text(json.dumps(_replay_doc(260.0)))
+    bench_doc = tmp_path / "bench.json"
+    bench_doc.write_text(json.dumps({"metric": "m", "value": 1.0,
+                                     "vs_baseline": 1.2}))
+    assert report_main(["--check", str(serve_doc),
+                        "--baseline", str(bench_doc)]) == 2
+    assert report_main(["--check", str(bench_doc),
+                        "--baseline", str(serve_doc)]) == 2
+    # same-family pairs still work through the same CLI
+    assert report_main(["--check", str(serve_doc),
+                        "--baseline", str(serve_doc)]) == 0
+
+
+def test_latest_snapshots_survives_seq_reset_across_restart(tmp_path,
+                                                            registry,
+                                                            tracer):
+    """A restarted process starts at seq 0 while the dead incarnation's
+    high-seq docs still occupy other ring slots: wall-clock ordering
+    must pick the LIVE process's snapshot."""
+    w1 = MetricsSnapshotWriter(str(tmp_path), "own", ring=4,
+                               registry=registry, tracer=tracer)
+    w1.seq = 90  # the old incarnation died at seq 93
+    for _ in range(4):
+        w1.write(state="serving")
+    # the restart: fresh writer, seq 0, strictly later wall clock
+    w2 = MetricsSnapshotWriter(str(tmp_path), "own", ring=4,
+                               registry=registry, tracer=tracer)
+    time.sleep(0.01)
+    w2.write(state="idle")
+    latest = latest_snapshots(str(tmp_path))
+    assert latest["own"]["seq"] == 0
+    assert latest["own"]["state"] == "idle"

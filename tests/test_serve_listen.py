@@ -345,3 +345,121 @@ def test_socket_transport_round_trip(warmed, tmp_path):
     assert not t.is_alive()
     assert result["summary"]["counters"]["requests"] == 2
     assert not os.path.exists(sock_path)  # cleaned up on exit
+
+
+# -- fleet telemetry plane (ISSUE 12) ----------------------------------------
+
+def test_trace_context_and_phase_breakdown(warmed, tmp_path):
+    """Every response names its trace; exact resolutions carry the
+    per-phase breakdown (fingerprint / cache_probe / serialize) the
+    tens-of-µs profile needs; a client-supplied trace is adopted."""
+    loop = ServeLoop(warmed, ListenOpts(
+        max_pending=8, workers=1, request_timeout_secs=60.0,
+        handle_signals=False, status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    loop.submit({"op": "query", "id": 1,
+                 "request": {"workload": "spmv", "m": 512}}, respond)
+    loop.submit({"op": "query", "id": 2,
+                 "trace": {"trace_id": "feed" * 4, "span_id": "00" * 8},
+                 "request": {"workload": "spmv", "m": 512}}, respond)
+    loop.drain(timeout=10.0)
+    by_id = {d["id"]: d for d in docs}
+    d1 = by_id[1]
+    assert d1["ok"] and len(d1["trace_id"]) == 16
+    r1 = d1["result"]
+    assert r1["trace_id"] == d1["trace_id"]  # one id, transport == tiers
+    ph = r1["phase_us"]
+    assert {"fingerprint", "cache_probe", "serialize"} <= set(ph)
+    assert all(v >= 0 for v in ph.values())
+    # the client's gateway trace id survives end to end
+    assert by_id[2]["trace_id"] == "feed" * 4
+    assert by_id[2]["result"]["trace_id"] == "feed" * 4
+    # the per-tier latency series exists for the SLO block to read
+    assert get_metrics().histogram("serve.resolve_us.exact").count >= 2
+
+
+def test_metrics_verb_and_snapshot_ring(warmed, tmp_path):
+    """The `metrics` op answers the same snapshot document the
+    heartbeat publishes — registry, tracer retention, SLO block — and
+    the drain writes a final snapshot into the bounded ring."""
+    loop = ServeLoop(warmed, ListenOpts(
+        max_pending=8, workers=1, request_timeout_secs=60.0,
+        handle_signals=False, owner="msnap", slo_target_us=1e9,
+        status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    loop.submit({"op": "query", "id": 0,
+                 "request": {"workload": "spmv", "m": 512}}, respond)
+    loop.submit({"op": "metrics", "id": 1}, respond)
+    loop.drain(timeout=10.0)
+    m = next(d for d in docs if d["id"] == 1)["metrics"]
+    assert m["kind"] == "metrics_snapshot" and m["owner"] == "msnap"
+    assert "counters" in m["metrics"] and "dropped_spans" in m["tracer"]
+    slo = m["slo"]
+    assert slo["target_us"] == 1e9
+    assert slo["histogram"] == "serve.resolve_us.exact"
+    # the drain wrote a ring snapshot next to the status doc
+    from tenzing_tpu.obs.metrics import latest_snapshots
+
+    latest = latest_snapshots(str(tmp_path))
+    assert "msnap" in latest
+    assert latest["msnap"]["state"] == "stopped"
+    assert latest["msnap"]["queue_depth"] == 0
+
+
+def test_tenant_histograms_bounded(warmed, tmp_path):
+    """Per-tenant latency series are admitted up to tenant_cap; later
+    tenants aggregate under `other` — client-controlled labels cannot
+    grow the registry without bound."""
+    from tenzing_tpu.obs.metrics import MetricsRegistry, set_metrics
+
+    reg = MetricsRegistry()
+    prev = set_metrics(reg)
+    try:
+        loop = ServeLoop(warmed, ListenOpts(
+            max_pending=8, workers=1, request_timeout_secs=60.0,
+            handle_signals=False, tenant_cap=2,
+            status_path=str(tmp_path / "status.json")))
+        loop.start()
+        docs, respond = _collect()
+        for i, tenant in enumerate(("t-a", "t-b", "t-c", "t-d", "t-a")):
+            loop.submit({"op": "query", "id": i, "tenant": tenant,
+                         "request": {"workload": "spmv", "m": 512}},
+                        respond)
+        loop.drain(timeout=10.0)
+        assert all(d.get("ok") for d in docs)
+        names = set(reg.histograms())
+        assert "serve.tenant.t-a.resolve_us" in names
+        assert "serve.tenant.t-b.resolve_us" in names
+        assert "serve.tenant.other.resolve_us" in names
+        assert "serve.tenant.t-c.resolve_us" not in names
+        assert "serve.tenant.t-d.resolve_us" not in names
+        assert reg.histogram("serve.tenant.t-a.resolve_us").count == 2
+        assert reg.histogram("serve.tenant.other.resolve_us").count == 2
+        assert reg.counter("serve.tenant.other.exact").value == 2
+    finally:
+        set_metrics(prev)
+
+
+def test_cold_work_item_carries_ingress_trace(tmp_path, corpus):
+    """The ingress-minted trace context rides the cold work item's
+    checkpoint envelope — the daemon drain it causes links back to this
+    exact query (the tentpole linkage, asserted end-to-end in
+    tests/test_daemon.py)."""
+    from tenzing_tpu.fault.checkpoint import read_checked_json
+
+    svc = ScheduleService(str(tmp_path / "store"),
+                          queue_dir=str(tmp_path / "queue"))
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=8, workers=1, request_timeout_secs=60.0,
+        handle_signals=False, status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    loop.submit({"op": "query", "id": 1,
+                 "request": {"workload": "spmv", "m": 512}}, respond)
+    loop.drain(timeout=10.0)
+    d = docs[0]
+    assert d["result"]["tier"] == "cold"
+    item = read_checked_json(d["result"]["work_item"])
+    assert item["trace"]["trace_id"] == d["trace_id"]
