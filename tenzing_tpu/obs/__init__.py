@@ -19,6 +19,12 @@ The observability layer the whole decision loop reports through (ISSUE 1):
 * :mod:`tenzing_tpu.obs.export` — JSONL (machine consumption) and Chrome
   trace-event JSON (load in Perfetto / chrome://tracing) sinks, and the
   cross-process trace stitcher (``python -m tenzing_tpu.obs.export``).
+* :mod:`tenzing_tpu.obs.alerts` — the watchtower's alert engine
+  (``python -m tenzing_tpu.obs.alerts check``): the declarative rule
+  catalog (multi-window SLO burn, stale heartbeats, shed/queue/poison)
+  evaluated over the fleet's status + snapshot documents, with a
+  firing/resolved ledger CI gates on (docs/observability.md
+  "Watchtower").
 
 Everything here is stdlib-only so any module in the package can import it
 without cycles.  See docs/observability.md for the end-to-end workflow.
@@ -43,6 +49,7 @@ from tenzing_tpu.obs.metrics import (
     get_metrics,
     latest_snapshots,
     set_metrics,
+    snapshot_history,
 )
 from tenzing_tpu.obs.progress import ProgressReporter, get_reporter, set_reporter
 from tenzing_tpu.obs.tracer import Event, Span, Tracer, configure, get_tracer, set_tracer
@@ -70,6 +77,7 @@ __all__ = [
     "set_metrics",
     "set_reporter",
     "set_tracer",
+    "snapshot_history",
     "stitch",
     "to_jsonl",
     "write_chrome_trace",

@@ -403,23 +403,26 @@ class MetricsSnapshotWriter:
         return path
 
 
-def latest_snapshots(directory: str) -> Dict[str, Dict[str, Any]]:
-    """The newest snapshot document per owner found in ``directory``
-    (max ``(written_at, seq)`` wins).  Wall-clock first, seq as the
+def _snapshot_key(doc) -> tuple:
+    try:
+        at = float(doc.get("written_at", 0))
+    except (TypeError, ValueError):
+        at = 0.0
+    return (at, doc.get("seq", -1))
+
+
+def snapshot_history(directory: str) -> Dict[str, List[Dict[str, Any]]]:
+    """Every readable snapshot document in ``directory``, grouped by
+    owner and ordered oldest-first by ``(written_at, seq)`` — the ring
+    as a short time series.  This is what multi-window evaluation
+    (obs/alerts.py burn rates) reads: the newest document is one
+    window, the whole ring is the other.  Wall-clock first, seq as the
     tiebreak: a restarted process starts over at seq 0 while the dead
     incarnation's high-seq documents still occupy the other ring slots
     — ordering by seq alone would show the dead process's state for up
     to ring-1 heartbeats.  Unreadable/foreign files are skipped: the
-    follow view must render whatever half-written fleet state exists."""
-    out: Dict[str, Dict[str, Any]] = {}
-
-    def key(doc):
-        try:
-            at = float(doc.get("written_at", 0))
-        except (TypeError, ValueError):
-            at = 0.0
-        return (at, doc.get("seq", -1))
-
+    readers must render whatever half-written fleet state exists."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
     if not os.path.isdir(directory):
         return out
     for name in sorted(os.listdir(directory)):
@@ -432,8 +435,15 @@ def latest_snapshots(directory: str) -> Dict[str, Dict[str, Any]]:
             continue
         if doc.get("kind") != "metrics_snapshot":
             continue
-        owner = doc.get("owner", "?")
-        prev = out.get(owner)
-        if prev is None or key(doc) > key(prev):
-            out[owner] = doc
+        out.setdefault(doc.get("owner", "?"), []).append(doc)
+    for docs in out.values():
+        docs.sort(key=_snapshot_key)
     return out
+
+
+def latest_snapshots(directory: str) -> Dict[str, Dict[str, Any]]:
+    """The newest snapshot document per owner found in ``directory``
+    (max ``(written_at, seq)`` wins — :func:`snapshot_history` for the
+    ordering rationale)."""
+    return {owner: docs[-1]
+            for owner, docs in snapshot_history(directory).items() if docs}

@@ -581,6 +581,7 @@ def store_section(store_paths: List[str],
             lines += segment_lines(st)
         if os.path.isdir(path):
             lines += serve_status_lines(path)
+            lines += reqlog_lines(path)
     if queue_dir is not None:
         if not os.path.isdir(queue_dir):
             # surface the operator error (a typo'd path) instead of
@@ -664,6 +665,44 @@ def serve_status_lines(store_dir: str) -> List[str]:
             f"{st.get('queue_depth', 0)}")
     if lines:
         lines.append("")
+    return lines
+
+
+def reqlog_lines(store_dir: str) -> List[str]:
+    """The watchtower's recording state under a store directory
+    (serve/reqlog.py, conventionally ``<store>/reqlog``): recorded
+    traffic coverage and the exemplar bundles — THE exact worst
+    requests behind a bad pct99, not an aggregate."""
+    d = os.path.join(store_dir, "reqlog")
+    if not os.path.isdir(d):
+        return []
+    from tenzing_tpu.serve.reqlog import read_exemplars, read_request_log
+
+    lines: List[str] = []
+    try:
+        data = read_request_log(d)
+    except OSError:
+        return [f"- request log `{d}`: unreadable", ""]
+    lines.append(
+        f"- request log `{d}`: {len(data['records'])} record(s) across "
+        f"{data['segments']} segment(s), {data['dropped_sampling']} "
+        f"sampled out" +
+        (f"; damage: {data['damaged']} segment(s), "
+         f"{data['checksum_failed']} bad checksum(s), "
+         f"{data['torn_lines']} torn line(s)"
+         if data["damaged"] else ""))
+    exemplars = read_exemplars(os.path.join(d, "exemplars"))
+    if exemplars:
+        lines += ["", "| exemplar (worst requests) | reason | tier | "
+                  "resolve (us) | trace records |", "|---|---|---|---|---|"]
+        for ex in exemplars[:12]:
+            rec = ex.get("record") or {}
+            lines.append(
+                f"| `{str(ex.get('trace_id', '?'))[:16]}` | "
+                f"{ex.get('reason', '?')} | {rec.get('tier', '—')} | "
+                f"{rec.get('resolve_us', '—')} | "
+                f"{ex.get('n_trace_records', 0)} |")
+    lines.append("")
     return lines
 
 
@@ -842,6 +881,8 @@ def fleet_lines(store_dirs: List[str],
                 tr = snap.get("tracer") or {}
                 extras = [f"queue age {gauges.get('serve.queue_age_s', 0)}s",
                           f"shed rate {gauges.get('serve.shed_rate', 0)}/s"]
+                if snap.get("uptime_s") is not None:
+                    extras.append(f"up {snap['uptime_s']}s")
                 if tr.get("dropped_spans") or tr.get("dropped_events"):
                     extras.append(
                         f"tracer dropped {tr.get('dropped_spans', 0)}sp/"
@@ -849,6 +890,16 @@ def fleet_lines(store_dirs: List[str],
                 lines.append(f"       {', '.join(extras)}")
                 if snap.get("slo"):
                     lines.append(f"       slo: {_slo_line(snap['slo'])}")
+                rl = snap.get("reqlog")
+                if rl:
+                    # the traffic recorder's own position (serve/
+                    # reqlog.py): the watchtower is observable too
+                    lines.append(
+                        f"       reqlog: {rl.get('records', 0)} rec / "
+                        f"{rl.get('segments', 0)} seg "
+                        f"({rl.get('bytes', 0)}B, "
+                        f"{rl.get('buffered', 0)} buffered, "
+                        f"{rl.get('dropped_sampling', 0)} sampled out)")
     for qd in queue_dirs:
         if not os.path.isdir(qd):
             lines.append(f"queue  {qd}: missing directory")
@@ -900,6 +951,29 @@ def fleet_lines(store_dirs: List[str],
                     f"       item age "
                     f"{gauges.get('daemon.item_age_s', 0)}s, lease age "
                     f"{gauges.get('daemon.lease_age_s', 0)}s")
+    # firing alerts last — the line the eye should land on (live
+    # evaluation, read-only; the persisted ledger is rendered beside it)
+    from tenzing_tpu.obs.alerts import firing_lines
+
+    lines += firing_lines(store_dirs, queue_dirs)
+    for d in dict.fromkeys(store_dirs + queue_dirs):
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if not (name.startswith("alerts-") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            firing = doc.get("firing", [])
+            lines.append(
+                f"ledger {name}: {len(firing)} firing, updated "
+                f"{_age(doc, 'updated_at', now)} ago"
+                + (f" ({', '.join(firing[:4])}"
+                   + (", ..." if len(firing) > 4 else "") + ")"
+                   if firing else ""))
     if len(lines) <= 2:
         lines.append("(no status documents found)")
     lines.append("")

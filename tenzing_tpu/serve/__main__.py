@@ -173,6 +173,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "SLO burn direction")
     pl.add_argument("--metrics-ring", type=int, default=8,
                     help="metric-snapshot files kept per owner")
+    pl.add_argument("--record", default=None, metavar="DIR",
+                    help="record admitted traffic into this request-log "
+                         "directory (serve/reqlog.py; replay it with "
+                         "python -m tenzing_tpu.serve.replay "
+                         "--from-recorded DIR)")
+    pl.add_argument("--record-sample", type=float, default=1.0,
+                    help="request-log sampling rate (deterministic per "
+                         "trace_id; dropped requests are counted)")
+    pl.add_argument("--record-retain", type=int, default=16,
+                    help="sealed request-log segments kept (rotation)")
+    pl.add_argument("--exemplar-k", type=int, default=4,
+                    help="slowest-K span bundles kept per heartbeat "
+                         "window (shed/timeout/error always kept)")
+    pl.add_argument("--exemplar-cap", type=int, default=64,
+                    help="exemplar bundles kept before oldest-first "
+                         "eviction")
 
     pc = sub.add_parser("compact",
                         help="one offline compaction pass over a "
@@ -232,7 +248,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             status_path=args.status, socket_path=args.socket,
             slo_target_us=args.slo_target_us,
             slo_baseline=args.slo_baseline,
-            metrics_ring=args.metrics_ring, trace_out=trace_out)
+            metrics_ring=args.metrics_ring, trace_out=trace_out,
+            record_dir=args.record, record_sample=args.record_sample,
+            record_retain=args.record_retain,
+            exemplar_k=args.exemplar_k, exemplar_cap=args.exemplar_cap)
         loop = ServeLoop(svc, opts,
                          log=lambda m: sys.stderr.write(m + "\n"))
         if args.socket:

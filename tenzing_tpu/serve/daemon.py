@@ -399,6 +399,7 @@ class DrainDaemon:
             "host": socket.gethostname(),
             "started_at": self.started_at,
             "heartbeat_at": time.time(),
+            "uptime_s": round(time.time() - self.started_at, 1),
             "state": state,
             "item": item,
             "queue_depth": self._depth,
@@ -413,7 +414,8 @@ class DrainDaemon:
         try:
             self._snapshots.write(state=state, extra={
                 "counters": dict(self.counters),
-                "queue_depth": self._depth})
+                "queue_depth": self._depth,
+                "uptime_s": round(time.time() - self.started_at, 1)})
         except OSError as e:
             self._log(f"metrics snapshot failed ({e})")
 

@@ -53,6 +53,17 @@ load-shedding request loop (docs/serving.md "Listen mode"):
   committed SERVE_BENCH baseline); the ``metrics`` protocol verb
   answers the same document on demand.
 
+* **Watchtower** (docs/observability.md "Watchtower") — ``--record
+  DIR`` turns the loop into a production traffic recorder
+  (serve/reqlog.py): one sampled, checksummed, rotation-capped log
+  record per admitted request (verbatim kwargs, tier, digests, latency
+  phases, shed/timeout outcome — the empirical mix ``serve/replay.py
+  --from-recorded`` replays), full span bundles for the interesting
+  requests (slowest-K per heartbeat window, every
+  shed/timeout/error/unverified) under ``DIR/exemplars/``, per-tenant
+  shed/timeout counters, and a ``reqlog`` position block in every
+  metric snapshot so the recorder is itself observable.
+
 Every response carries ``resolve_us`` (the resolution's own latency,
 excluding queue wait) so a replaying client can build the latency
 distribution the ROADMAP's pct99 metric tracks without trusting the
@@ -110,6 +121,14 @@ class ListenOpts:
     # aggregate under "other" — per-tenant series must not let a
     # client-controlled string grow the registry without bound
     tenant_cap: int = 16
+    # -- watchtower: production traffic recording (serve/reqlog.py) --
+    record_dir: Optional[str] = None     # enables the request log
+    record_sample: float = 1.0           # deterministic per-trace draw
+    record_segment_records: int = 256    # records per sealed segment
+    record_retain: int = 16              # sealed segments kept (rotation)
+    record_flush_secs: float = 30.0      # heartbeat-side publish cadence
+    exemplar_k: int = 4                  # slowest-K bundles per window
+    exemplar_cap: int = 64               # exemplar files kept
 
 
 class _Pending:
@@ -212,6 +231,27 @@ class ServeLoop:
         # the registry against client-controlled label cardinality
         self._tenants: "set[str]" = set()
         self._shed_window = (time.time(), 0)  # (window start, sheds then)
+        # -- watchtower (serve/reqlog.py): the production traffic
+        # recorder and the tail-sampled exemplar store, both opt-in via
+        # record_dir — every admitted request lands one sampled,
+        # checksummed log record; interesting requests (slowest-K per
+        # window, every shed/timeout/error/unverified) keep their full
+        # span bundle keyed by trace_id
+        self._reqlog = None
+        self._exemplars = None
+        self._last_record_flush = time.time()
+        if self.opts.record_dir:
+            from tenzing_tpu.serve.reqlog import ExemplarStore, RequestLog
+
+            self._reqlog = RequestLog(
+                self.opts.record_dir, owner=self.owner,
+                sample=self.opts.record_sample,
+                segment_records=self.opts.record_segment_records,
+                retain_segments=self.opts.record_retain, log=self._log)
+            self._exemplars = ExemplarStore(
+                os.path.join(self.opts.record_dir, "exemplars"),
+                k=self.opts.exemplar_k, cap=self.opts.exemplar_cap,
+                log=self._log)
 
     def _log(self, msg: str) -> None:
         if self._log_fn is not None:
@@ -233,6 +273,7 @@ class ServeLoop:
             "host": _socket.gethostname(),
             "started_at": self.started_at,
             "heartbeat_at": time.time(),
+            "uptime_s": round(time.time() - self.started_at, 1),
             "state": state,
             "queue_depth": self._queue.qsize(),
             "in_flight": len(self._live),
@@ -287,17 +328,32 @@ class ServeLoop:
 
     def _shed(self, pending: _Pending, reason: str) -> None:
         self._bump("shed")
-        get_metrics().counter("serve.shed").inc()
+        reg = get_metrics()
+        reg.counter("serve.shed").inc()
+        # per-tenant shed economics (ISSUE 13 satellite): the fairness
+        # measurement the ROADMAP's per-tenant admission item needs —
+        # capped to "other" exactly like the latency series
+        label = self._tenant_label(self._tenant_of(pending.payload))
+        if label is not None:
+            reg.counter(f"serve.shed.{label}").inc()
         tr = get_tracer()
         if tr.enabled:
             tr.event("serve.shed", reason=reason,
                      depth=self._queue.qsize())
-        pending.complete({
+        doc = {
             "ok": False, "shed": True, "reason": reason,
             "retry_after": self.opts.shed_retry_after_secs,
-            "error_class": "transient"})
+            "error_class": "transient"}
+        if pending.complete(doc):
+            self._record(pending, doc)
 
     # -- workers -------------------------------------------------------------
+    @staticmethod
+    def _tenant_of(payload: Any) -> Optional[str]:
+        """The request's tenant tag — THE one extraction shed, timeout
+        and recording all share (a payload is client input: any shape)."""
+        return payload.get("tenant") if isinstance(payload, dict) else None
+
     def _tenant_label(self, tenant: Optional[str]) -> Optional[str]:
         """The bounded per-tenant histogram label: the first
         ``tenant_cap`` distinct tenants get their own series, later ones
@@ -401,7 +457,8 @@ class ServeLoop:
                            "error_class": classify_error(e)}
                 # a late result loses to the watchdog silently: the
                 # client already got its transient-classified timeout
-                pending.complete(doc)
+                if pending.complete(doc):
+                    self._record(pending, doc)
             finally:
                 with self._live_lock:
                     self._live.discard(pending)
@@ -415,15 +472,22 @@ class ServeLoop:
                            if p.deadline is not None and now > p.deadline
                            and not p.done]
             for p in overdue:
-                if p.complete({
-                        "ok": False, "timed_out": True,
-                        "error": (f"request exceeded "
-                                  f"{self.opts.request_timeout_secs}s "
-                                  "watchdog"),
-                        "error_class": "transient",
-                        "retry_after": self.opts.shed_retry_after_secs}):
+                doc = {
+                    "ok": False, "timed_out": True,
+                    "error": (f"request exceeded "
+                              f"{self.opts.request_timeout_secs}s "
+                              "watchdog"),
+                    "error_class": "transient",
+                    "retry_after": self.opts.shed_retry_after_secs}
+                if p.complete(doc):
                     self._bump("timeouts")
-                    get_metrics().counter("serve.listen.timeouts").inc()
+                    reg = get_metrics()
+                    reg.counter("serve.listen.timeouts").inc()
+                    # per-tenant timeout twin of serve.shed.<tenant>
+                    label = self._tenant_label(self._tenant_of(p.payload))
+                    if label is not None:
+                        reg.counter(f"serve.timeout.{label}").inc()
+                    self._record(p, doc)
                 with self._live_lock:
                     self._live.discard(p)
             # sleep on ABANDON, not stop: once stop is set (the whole
@@ -435,13 +499,125 @@ class ServeLoop:
                     self._queue.empty():
                 return
 
+    # -- watchtower recording (serve/reqlog.py) ------------------------------
+    def _record(self, pending: _Pending, doc: Dict[str, Any]) -> None:
+        """Append this completed request to the production traffic log
+        and offer it to the exemplar store — one record per resolved
+        request (batch members each get their own), carrying the
+        verbatim request kwargs so ``serve/replay.py --from-recorded``
+        can re-issue the exact query stream."""
+        if self._reqlog is None:
+            return
+        from tenzing_tpu.serve.reqlog import RECORD_VERSION
+
+        payload = (pending.payload
+                   if isinstance(pending.payload, dict) else {})
+        op = payload.get("op", "query")
+        if op not in ("query", "batch"):
+            return
+        trace_id = pending.ctx.trace_id if pending.ctx is not None else None
+        tenant = self._tenant_of(payload)
+        if doc.get("shed"):
+            outcome = "shed"
+        elif doc.get("timed_out"):
+            outcome = "timeout"
+        elif not doc.get("ok"):
+            outcome = "error"
+        else:
+            outcome = "served"
+        if op == "batch":
+            reqs = payload.get("requests") or []
+            results = doc.get("results") or [None] * len(reqs)
+            triples = []
+            for r, res in zip(reqs, results):
+                req = r.get("request", r) if isinstance(r, dict) else {}
+                t = (r.get("tenant", tenant)
+                     if isinstance(r, dict) else tenant)
+                triples.append((req, t, res))
+        else:
+            triples = [(payload.get("request") or {}, tenant,
+                        doc.get("result"))]
+        for req, t, res in triples:
+            res = res if isinstance(res, dict) else {}
+            # the whole-request outcome, refined per batch member: a
+            # batch answered ok can still carry individual errors
+            out = outcome
+            if out == "served" and "tier" not in res:
+                out = "error"
+            rec: Dict[str, Any] = {
+                "v": RECORD_VERSION,
+                "ts": pending.enqueued_at,
+                "trace_id": trace_id,
+                "tenant": t,
+                "op": op,
+                "outcome": out,
+                "request": req,
+            }
+            if out in ("error", "timeout", "shed"):
+                rec["error_class"] = (res.get("error_class")
+                                      or doc.get("error_class"))
+            if "tier" in res:
+                fp = res.get("fingerprint") or {}
+                rec.update({
+                    "tier": res.get("tier"),
+                    "workload": fp.get("workload"),
+                    "exact": fp.get("exact"),
+                    "bucket": fp.get("bucket_digest"),
+                    "resolve_us": res.get("resolve_us"),
+                    "phase_us": res.get("phase_us"),
+                })
+            interesting = None
+            if out in ("shed", "timeout", "error"):
+                interesting = out
+            elif (res.get("provenance") or {}).get("verified") is False:
+                interesting = "unverified"
+            try:
+                # recording must never take the serving path down: a
+                # full disk (or a record a caller made unserializable)
+                # costs the record, not the response — and never the
+                # worker/watchdog thread it would otherwise kill
+                self._reqlog.append(rec)
+                if self._exemplars is not None:
+                    self._exemplars.offer(rec, interesting=interesting)
+            except Exception as e:
+                self._log(f"request-log append failed "
+                          f"({type(e).__name__}: {e})")
+
+    def _record_tick(self) -> None:
+        """The heartbeat's recording housekeeping: publish the buffered
+        log records every ``record_flush_secs`` (a SIGKILLed loop then
+        loses at most one cadence window) and close the exemplar
+        window (slowest-K per heartbeat window)."""
+        if self._reqlog is None:
+            return
+        now = time.time()
+        try:
+            # sealed batches rotate on the request path with zero I/O;
+            # THIS thread pays their fsyncs every heartbeat, and the
+            # partial buffer every record_flush_secs
+            self._reqlog.publish_pending()
+            if now - self._last_record_flush >= \
+                    self.opts.record_flush_secs:
+                self._last_record_flush = now
+                self._reqlog.flush()
+        except OSError as e:
+            self._log(f"request-log flush failed ({e})")
+        if self._exemplars is not None:
+            self._exemplars.roll()
+
     def _snapshot_extra(self) -> Dict[str, Any]:
         """The loop-level block metric snapshots carry beside the raw
-        registry: the counters the status doc publishes plus the
-        derived queue-age / shed-rate gauges."""
-        return {"counters": dict(self.counters),
-                "queue_depth": self._queue.qsize(),
-                "in_flight": len(self._live)}
+        registry: the counters the status doc publishes, the derived
+        queue-age / shed-rate gauges, the loop's uptime, and — so the
+        recorder is itself observable — the request-log position."""
+        out: Dict[str, Any] = {
+            "counters": dict(self.counters),
+            "queue_depth": self._queue.qsize(),
+            "in_flight": len(self._live),
+            "uptime_s": round(time.time() - self.started_at, 1)}
+        if self._reqlog is not None:
+            out["reqlog"] = self._reqlog.position()
+        return out
 
     def _observe_gauges(self) -> None:
         reg = get_metrics()
@@ -470,6 +646,7 @@ class ServeLoop:
                                       extra=self._snapshot_extra())
             except OSError as e:
                 self._log(f"metrics snapshot failed ({e})")
+            self._record_tick()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -508,6 +685,15 @@ class ServeLoop:
         for t in self._threads:
             t.join(timeout=max(0.1, deadline - time.time()))
         ok = self._queue.empty()
+        # seal the recording before the final snapshot so the position
+        # block in the "stopped" snapshot reflects the published truth
+        if self._exemplars is not None:
+            self._exemplars.roll()
+        if self._reqlog is not None:
+            try:
+                self._reqlog.flush()
+            except OSError as e:
+                self._log(f"request-log flush failed ({e})")
         self._write_status("stopped")
         self._observe_gauges()
         try:
@@ -551,9 +737,14 @@ class ServeLoop:
         self._prev_handlers.clear()
 
     def summary(self) -> Dict[str, Any]:
-        return {"owner": self.owner, "counters": dict(self.counters),
-                "status": self.status_path,
-                "wall_s": round(time.time() - self.started_at, 3)}
+        out = {"owner": self.owner, "counters": dict(self.counters),
+               "status": self.status_path,
+               "wall_s": round(time.time() - self.started_at, 3)}
+        if self._reqlog is not None:
+            out["reqlog"] = self._reqlog.position()
+        if self._exemplars is not None:
+            out["exemplars"] = self._exemplars.written
+        return out
 
     # -- transports ----------------------------------------------------------
     def serve_stdin(self, stdin=None, stdout=None) -> Dict[str, Any]:

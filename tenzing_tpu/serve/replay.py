@@ -32,6 +32,16 @@ warmed shape's bucket (power-of-two bucketing, serve/fingerprint.py),
 "cold" shapes in other buckets — so the trace exercises the cache, the
 near tier's surrogate pricing, and the cold tier's ensure-not-rewrite
 path in one stream.
+
+**Recorded traffic** (ISSUE 13 tentpole; docs/observability.md
+"Watchtower"): ``--record DIR`` turns the segmented path's listen loop
+into a production recorder (serve/reqlog.py), and ``--from-recorded
+DIR`` replays the *empirical* mix instead of the synthetic generator —
+request kwargs verbatim from the log, tier/workload mix and the paced
+QPS reconstructed from the recorded stream's inter-arrival times
+(``--qps`` still overrides).  The result document then carries a
+``recorded`` block (source coverage, empirical mix, QPS estimate) so a
+committed ``SERVE_BENCH_r*.json`` says which traffic it measured.
 """
 
 from __future__ import annotations
@@ -50,7 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.utils.numeric import percentile
 
-REPLAY_VERSION = 2
+REPLAY_VERSION = 3
 # raw exact-tier latency series retained in the result document (replay
 # order preserved): the regression gate's noise-awareness runs the
 # bench/randomness.py runs test over it — and 512 points bound the
@@ -94,6 +104,81 @@ def build_trace(workloads: List[str], n: int, seed: int,
         kind = rng.choices(kinds, weights=weights, k=1)[0]
         out.append({"kind": kind, "request": _req_kwargs(wl, kind, i)})
     return out
+
+
+def trace_from_recorded(directory: str,
+                        log=None) -> Tuple[List[Dict[str, Any]],
+                                           Dict[str, Any]]:
+    """The query trace reconstructed from a recorded request log
+    (serve/reqlog.py; module docstring): every replayable record —
+    query/batch with its verbatim request kwargs — becomes one trace
+    entry in arrival order, its ``kind`` the tier it resolved to (or
+    the shed/timeout outcome for requests that never resolved; offered
+    load is offered load).  Returns ``(trace, info)`` where ``info`` is
+    the ``recorded`` provenance block: empirical tier mix, workloads,
+    the inter-arrival QPS estimate, and the log's coverage/damage
+    tallies."""
+    from tenzing_tpu.bench.driver import DriverRequest
+    from tenzing_tpu.serve.reqlog import read_request_log
+
+    data = read_request_log(directory, log=log)
+    # an EMPTY kwargs dict stays in: {"op": "query"} with no body is a
+    # valid all-defaults DriverRequest, and a log dominated by
+    # default-shape queries must not silently reconstruct as empty
+    recs = [r for r in data["records"]
+            if r.get("op") in ("query", "batch")
+            and isinstance(r.get("request"), dict)]
+    trace: List[Dict[str, Any]] = []
+    mix_n: Dict[str, int] = {}
+    outcomes: Dict[str, int] = {}
+    workloads: set = set()
+    unreplayable = 0
+    for r in list(recs):
+        try:
+            # the log records kwargs verbatim, validated or not — a shed
+            # or errored request never reached DriverRequest, so an
+            # off-schema record must be skipped (and counted), not crash
+            # the whole replay at reconstruction time
+            DriverRequest(**r["request"])
+        except TypeError:
+            unreplayable += 1
+            recs.remove(r)
+            continue
+        kind = r.get("tier") or r.get("outcome") or "recorded"
+        trace.append({"kind": kind, "request": r["request"]})
+        mix_n[kind] = mix_n.get(kind, 0) + 1
+        outcomes[r.get("outcome", "?")] = \
+            outcomes.get(r.get("outcome", "?"), 0) + 1
+        wl = r.get("workload") or r["request"].get("workload")
+        if wl:
+            workloads.add(wl)
+    if not trace:
+        raise ValueError(f"{directory}: no replayable request records")
+    if unreplayable and log is not None:
+        log(f"replay: skipped {unreplayable} unreplayable record(s) "
+            "(off-schema request kwargs)")
+    ts = [r["ts"] for r in recs if isinstance(r.get("ts"), (int, float))]
+    qps = None
+    if len(ts) >= 2 and ts[-1] > ts[0]:
+        # 3 decimals, not 1: a trickle recorded over an hour must not
+        # round to a falsy 0.0 and silently repace at the synthetic
+        # default — slow truth beats fast fiction (--qps overrides)
+        qps = round((len(ts) - 1) / (ts[-1] - ts[0]), 3)
+    n = len(recs)
+    info = {
+        "dir": directory,
+        "records": n,
+        "mix": {k: round(v / n, 4) for k, v in sorted(mix_n.items())},
+        "outcomes": dict(sorted(outcomes.items())),
+        "workloads": sorted(workloads),
+        "qps_estimate": qps,
+        "unreplayable": unreplayable,
+        "segments": data["segments"],
+        "damaged_segments": data["damaged"],
+        "checksum_failed": data["checksum_failed"],
+        "dropped_sampling": data["dropped_sampling"],
+    }
+    return trace, info
 
 
 def _series(lat_by_tier: Dict[str, List[float]]) -> Dict[str, Any]:
@@ -207,9 +292,12 @@ def _replay_legacy(mono_path: str, queue_dir: str, model_path: str,
 def _replay_segmented(seg_path: str, queue_dir: str,
                       trace: List[Dict[str, Any]], qps: float,
                       max_pending: int, workers: int,
-                      request_timeout: float, log) -> Dict[str, Any]:
+                      request_timeout: float, log,
+                      record_dir: Optional[str] = None) -> Dict[str, Any]:
     """The post-PR path through the real ServeLoop, paced at the target
-    QPS — shed and timeout counts are measured behavior."""
+    QPS — shed and timeout counts are measured behavior.  With
+    ``record_dir`` the loop additionally records the replayed traffic
+    (serve/reqlog.py) — the round-trip source for ``--from-recorded``."""
     from tenzing_tpu.bench.driver import DriverRequest
     from tenzing_tpu.serve.listen import ListenOpts, ServeLoop
     from tenzing_tpu.serve.service import ScheduleService
@@ -223,7 +311,8 @@ def _replay_segmented(seg_path: str, queue_dir: str,
         max_pending=max_pending, workers=workers,
         request_timeout_secs=request_timeout,
         status_path=os.path.join(seg_path, "status-replay.json"),
-        owner="replay", handle_signals=False), log=log)
+        owner="replay", handle_signals=False,
+        record_dir=record_dir), log=log)
     loop.start()
     results: List[Dict[str, Any]] = []
     lock = threading.Lock()
@@ -266,8 +355,11 @@ def _replay_segmented(seg_path: str, queue_dir: str,
                     exact_samples.append(r["resolve_us"])
             if r.get("provenance", {}).get("cache_hit"):
                 cache_hits += 1
+    out_reqlog = (loop.summary().get("reqlog")
+                  if record_dir is not None else None)
     return {
         "mode": "segmented",
+        **({"reqlog": out_reqlog} if out_reqlog else {}),
         "resolve_us": _series(lat),
         "phases_us": _phase_series(phases),
         "exact_samples_us": exact_samples,
@@ -289,22 +381,32 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
                workdir: Optional[str] = None, keep_workdir: bool = False,
                max_pending: int = 256, workers: int = 2,
                request_timeout: float = 30.0,
+               record_dir: Optional[str] = None,
+               trace: Optional[List[Dict[str, Any]]] = None,
+               recorded: Optional[Dict[str, Any]] = None,
                log=None) -> Dict[str, Any]:
     """The whole benchmark; returns the result document (see module
-    docstring)."""
+    docstring).  ``trace`` (with its ``recorded`` provenance block, from
+    :func:`trace_from_recorded`) replaces the synthetic generator;
+    ``record_dir`` records the segmented path's traffic."""
     mix = mix or {"exact": 0.8, "near": 0.15, "cold": 0.05}
     workloads = sorted(csv_globs)
     own_workdir = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="tz_serve_replay.")
     try:
         stores = _warm_stores(workdir, csv_globs, topk, log)
-        trace = build_trace(workloads, n, seed, mix)
+        if trace is None:
+            trace = build_trace(workloads, n, seed, mix)
+        else:
+            n = len(trace)
+            mix = (recorded or {}).get("mix", mix)
         legacy = _replay_legacy(
             stores["mono"], os.path.join(workdir, "q-mono"),
             stores["mono"] + ".model.json", trace, log)
         seg = _replay_segmented(
             stores["seg"], os.path.join(workdir, "q-seg"), trace, qps,
-            max_pending, workers, request_timeout, log)
+            max_pending, workers, request_timeout, log,
+            record_dir=record_dir)
         speedup = None
         le = legacy["resolve_us"].get("exact")
         se = seg["resolve_us"].get("exact")
@@ -316,6 +418,7 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
             "n": n, "qps": qps, "seed": seed, "mix": mix,
             "workloads": workloads,
             "warm": stores["warm"],
+            **({"recorded": recorded} if recorded else {}),
             "monolithic": legacy,
             "segmented": seg,
             "exact_pct99_speedup": speedup,
@@ -340,8 +443,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "committed experiments/spmv_search_tpu.csv)")
     ap.add_argument("--n", type=int, default=1200,
                     help="queries in the trace")
-    ap.add_argument("--qps", type=float, default=500.0,
-                    help="paced submission rate for the segmented path")
+    ap.add_argument("--qps", type=float, default=None,
+                    help="paced submission rate for the segmented path "
+                         "(default 500, or the recorded stream's "
+                         "inter-arrival estimate under --from-recorded)")
+    ap.add_argument("--record", default=None, metavar="DIR",
+                    help="record the segmented path's replayed traffic "
+                         "into this request-log directory "
+                         "(serve/reqlog.py)")
+    ap.add_argument("--from-recorded", dest="from_recorded", default=None,
+                    metavar="DIR",
+                    help="replay the empirical mix reconstructed from a "
+                         "recorded request log instead of the synthetic "
+                         "generator (docs/observability.md 'Watchtower')")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--mix", default="exact=0.8,near=0.15,cold=0.05",
                     help="tier-class mix, k=v comma list")
@@ -370,12 +484,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         csv_globs["halo"] = halo
     if spmv:
         csv_globs["spmv"] = spmv
-    doc = run_replay(csv_globs, n=args.n, qps=args.qps, seed=args.seed,
+    def log(m):
+        sys.stderr.write(m + "\n")
+
+    trace = recorded = None
+    qps = args.qps if args.qps is not None else 500.0
+    if args.from_recorded:
+        try:
+            trace, recorded = trace_from_recorded(args.from_recorded,
+                                                  log=log)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"replay: {e}\n")
+            return 2
+        est = recorded.get("qps_estimate")
+        if args.qps is None and est is not None and est > 0:
+            # pace like the recorded stream unless the operator says so
+            qps = est
+        sys.stderr.write(
+            f"replay: recorded trace {recorded['records']} request(s), "
+            f"mix {recorded['mix']}, qps~{recorded['qps_estimate']}\n")
+    doc = run_replay(csv_globs, n=args.n, qps=qps, seed=args.seed,
                      mix=mix, topk=args.topk, workdir=args.workdir,
                      keep_workdir=args.workdir is not None,
                      max_pending=args.max_pending, workers=args.workers,
                      request_timeout=args.request_timeout,
-                     log=lambda m: sys.stderr.write(m + "\n"))
+                     record_dir=args.record, trace=trace,
+                     recorded=recorded, log=log)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
@@ -388,6 +522,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "segmented_verifier_calls": doc["segmented"]["verifier_calls"],
         "shed": doc["segmented"]["shed"],
         "timeouts": doc["segmented"]["timeouts"],
+        **({"recorded_mix": doc["recorded"]["mix"]}
+           if "recorded" in doc else {}),
+        **({"reqlog": doc["segmented"]["reqlog"]}
+           if "reqlog" in doc["segmented"] else {}),
     }) + "\n")
     return 0
 

@@ -91,7 +91,7 @@ from tenzing_tpu.serve.store import (
     ScheduleStore,
     migrate_record,
 )
-from tenzing_tpu.utils.atomic import atomic_dump_json, fsync_dir
+from tenzing_tpu.utils.atomic import atomic_dump_json, publish_sealed
 
 SEGMENT_VERSION = 1
 MANIFEST_VERSION = 1
@@ -439,9 +439,9 @@ class SegmentedStore(ScheduleStore):
     def _publish_segment(self, bucket: str, recs: List[Record],
                          source: str) -> Tuple[str, Dict[str, Any]]:
         """Write one sealed segment (complete, fsynced, hard-linked into
-        place, directory fsynced) and return ``(name, manifest meta)``.
-        The caller indexes it; until then it is a loadable orphan."""
-        os.makedirs(self.segments_path, exist_ok=True)
+        place, directory fsynced — utils/atomic.py ``publish_sealed``)
+        and return ``(name, manifest meta)``.  The caller indexes it;
+        until then it is a loadable orphan."""
         header = {"kind": "segment", "version": SEGMENT_VERSION,
                   "bucket": bucket, "n_records": len(recs),
                   "schema": RECORD_SCHEMA, "created_at": time.time(),
@@ -451,27 +451,14 @@ class SegmentedStore(ScheduleStore):
                             sort_keys=True)
                  for r in recs]
         text = "\n".join(body) + "\n"
-        while True:
+
+        def make_name() -> str:
+            # fresh stamp per attempt: a rival writer's collision re-draws
             self._seg_counter += 1
-            name = (f"seg-{bucket}-{int(time.time() * 1e6)}-"
+            return (f"seg-{bucket}-{int(time.time() * 1e6)}-"
                     f"{self.owner}-{self._seg_counter}.jsonl")
-            final = os.path.join(self.segments_path, name)
-            tmp = final + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(text)
-                f.flush()
-                os.fsync(f.fileno())
-            try:
-                os.link(tmp, final)
-            except FileExistsError:
-                continue  # name collision with a rival writer: re-stamp
-            finally:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-            break
-        fsync_dir(self.segments_path)
+
+        name = publish_sealed(self.segments_path, make_name, text)
         meta = {"bucket": bucket, "records": len(recs),
                 "bytes": len(text), "created_at": header["created_at"],
                 "source": source, "sealed": True}

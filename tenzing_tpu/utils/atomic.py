@@ -37,6 +37,39 @@ def fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def publish_sealed(directory: str, make_name, text: str) -> str:
+    """Atomically publish one complete, immutable file into
+    ``directory``: private temp, fsync, hard-link to the name
+    ``make_name()`` returns (called again on a collision with a rival
+    publisher — the maker must stamp fresh names), temp unlinked,
+    directory fsynced.  A reader can never observe a torn acknowledged
+    file.  THE one copy of the sealed-publish dance shared by the
+    segmented store's segments and the watchtower's request log
+    (serve/segments.py, serve/reqlog.py) — a durability fix here fixes
+    both formats.  Returns the published name."""
+    os.makedirs(directory, exist_ok=True)
+    while True:
+        name = make_name()
+        final = os.path.join(directory, name)
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, final)
+        except FileExistsError:
+            continue
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        break
+    fsync_dir(directory)
+    return name
+
+
 def atomic_dump_json(path: str, doc: Dict[str, Any],
                      prefix: str = ".atomic.") -> None:
     """Atomically write ``doc`` as sorted-key JSON to ``path``.

@@ -1,0 +1,318 @@
+"""Watchtower alerting (ISSUE 13; docs/observability.md "Watchtower"):
+golden multi-window burn-rate math, every rule in the catalog against
+doctored fleet documents, the firing/resolved state machine (dedup,
+transition timestamps, hysteresis — no flapping), rule overrides, and
+the ``alerts check`` CLI exit-code contract (0 healthy / 1 firing /
+2 unreadable tree).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tenzing_tpu.obs.alerts import (
+    Alert,
+    AlertBook,
+    AlertTreeError,
+    DEFAULT_RULES,
+    burn_of,
+    evaluate,
+    firing_lines,
+    load_rules,
+)
+
+NOW = 1_700_000_000.0
+
+
+def snap(d, owner, seq, pct99, target=100.0, baseline=None,
+         state="serving", gauges=None, tracer=None, now=NOW):
+    doc = {"kind": "metrics_snapshot", "owner": owner, "seq": seq,
+           "written_at": now - (10 - seq), "state": state,
+           "metrics": {"counters": {}, "gauges": gauges or {},
+                       "histograms": {}},
+           "tracer": tracer or {"dropped_spans": 0, "dropped_events": 0}}
+    if pct99 is not None:
+        doc["slo"] = {"histogram": "serve.resolve_us.exact",
+                      "pct99_us": pct99, "target_us": target,
+                      "baseline_pct99_us": baseline}
+    json.dump(doc, open(os.path.join(d, f"metrics-{owner}-{seq}.json"),
+                        "w"))
+
+
+def status(d, owner, state="serving", hb_age=0.0, kind="serve_loop",
+           now=NOW):
+    json.dump({"kind": kind, "owner": owner, "state": state,
+               "heartbeat_at": now - hb_age},
+              open(os.path.join(d, f"status-{owner}.json"), "w"))
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    store = tmp_path / "store"
+    queue = tmp_path / "queue"
+    store.mkdir()
+    queue.mkdir()
+    return str(store), str(queue)
+
+
+# -- burn-rate math ----------------------------------------------------------
+
+def test_burn_of_golden():
+    assert burn_of({"pct99_us": 420.0, "target_us": 100.0}) == 4.2
+    # no target: the committed baseline anchors the burn
+    assert burn_of({"pct99_us": 150.0, "target_us": None,
+                    "baseline_pct99_us": 100.0}) == 1.5
+    assert burn_of({"pct99_us": None, "target_us": 100.0}) is None
+    assert burn_of({"pct99_us": 50.0}) is None
+
+
+def test_slo_burn_multiwindow_golden(tree):
+    store, queue = tree
+    # sustained burn: ring [110, 120, 400, 420] vs target 100
+    # fast = 4.2 (latest), slow = median([1.1, 1.2, 4.0, 4.2]) = 2.6
+    for i, p in enumerate([110.0, 120.0, 400.0, 420.0]):
+        snap(store, "burn", i, p)
+    alerts = evaluate([store], [queue], now=NOW)
+    assert [a.rule for a in alerts] == ["slo_burn"]
+    a = alerts[0]
+    assert a.subject == "burn" and a.severity == "page"
+    assert a.value == {"fast": 4.2, "slow": 2.6}
+    assert a.threshold == {"fast_burn": 2.0, "slow_burn": 1.5}
+
+
+def test_slo_burn_single_spike_does_not_fire(tree):
+    store, queue = tree
+    # one bad heartbeat in an otherwise healthy ring: fast window fires,
+    # slow window (median 1.0) vetoes — the multi-window no-flap point
+    for i, p in enumerate([100.0, 100.0, 100.0, 400.0]):
+        snap(store, "spike", i, p)
+    assert evaluate([store], [queue], now=NOW) == []
+
+
+def test_slo_burn_needs_min_window(tree):
+    """With a 1-2 doc ring the slow median IS the latest value, so the
+    multi-window veto would degenerate: a just-restarted loop's one
+    warm-up heartbeat must not page.  Three docs restore the veto."""
+    store, queue = tree
+    snap(store, "fresh", 0, 400.0)
+    assert evaluate([store], [queue], now=NOW) == []
+    snap(store, "fresh", 1, 410.0)
+    assert evaluate([store], [queue], now=NOW) == []
+    snap(store, "fresh", 2, 420.0)  # sustained across >= min_window
+    assert [a.rule for a in evaluate([store], [queue], now=NOW)] == \
+        ["slo_burn"]
+
+
+def test_slo_burn_stopped_owner_skipped(tree):
+    store, queue = tree
+    for i, p in enumerate([400.0, 420.0, 430.0, 440.0]):
+        snap(store, "gone", i, p, state="stopped" if i == 3 else "serving")
+    assert evaluate([store], [queue], now=NOW) == []
+
+
+# -- the rest of the catalog -------------------------------------------------
+
+def test_stale_heartbeat_rule(tree):
+    store, queue = tree
+    status(store, "dead", state="serving", hb_age=300.0)
+    status(store, "fresh", state="serving", hb_age=1.0)
+    status(queue, "done", state="stopped", hb_age=9999.0, kind=None)
+    alerts = evaluate([store], [queue], now=NOW)
+    assert [a.key for a in alerts] == ["stale_heartbeat:dead"]
+    assert alerts[0].value == 300.0
+
+
+def test_poison_and_queue_age_rules(tree):
+    store, queue = tree
+    json.dump({"kind": "poisoned_request"},
+              open(os.path.join(queue, "poison-deadbeef01.json"), "w"))
+    item = os.path.join(queue, "work-abc.json")
+    json.dump({"kind": "search_request"}, open(item, "w"))
+    os.utime(item, (NOW - 1000, NOW - 1000))
+    alerts = evaluate([store], [queue], now=NOW)
+    keys = sorted(a.key for a in alerts)
+    assert keys == ["poison:deadbeef01", f"queue_age:{queue}"]
+    age = next(a for a in alerts if a.rule == "queue_age")
+    assert age.value == 1000.0 and age.threshold == 600.0
+
+
+def test_shed_rate_queue_wait_and_tracer_drops(tree):
+    store, queue = tree
+    snap(store, "hot", 0, None,
+         gauges={"serve.shed_rate": 3.5, "serve.queue_age_s": 45.0},
+         tracer={"dropped_spans": 7, "dropped_events": 2})
+    alerts = {a.rule: a for a in evaluate([store], [queue], now=NOW)}
+    assert alerts["shed_rate"].value == 3.5
+    assert alerts["queue_age"].subject == "hot:pending"
+    assert alerts["tracer_drops"].value == 9
+
+
+def test_missing_tree_is_usage_error(tmp_path):
+    with pytest.raises(AlertTreeError):
+        evaluate([str(tmp_path / "nope")], [])
+    # the follow view renders through it instead of raising
+    assert firing_lines([str(tmp_path / "nope")], []) == []
+
+
+# -- rule configuration ------------------------------------------------------
+
+def test_load_rules_overrides(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps({"slo_burn": {"fast_burn": 9.0},
+                             "poison": {"enabled": False}}))
+    rules = load_rules(str(p), sets=["queue_age.max_s=5",
+                                     "shed_rate.severity=ticket"])
+    assert rules["slo_burn"]["fast_burn"] == 9.0
+    assert rules["slo_burn"]["slow_burn"] == 1.5  # untouched default
+    assert rules["poison"]["enabled"] is False
+    assert rules["queue_age"]["max_s"] == 5
+    assert rules["shed_rate"]["severity"] == "ticket"
+    assert DEFAULT_RULES["slo_burn"]["fast_burn"] == 2.0  # no mutation
+    with pytest.raises(AlertTreeError):
+        load_rules(sets=["nope.max_s=5"])
+    with pytest.raises(AlertTreeError):
+        load_rules(sets=["slo_burn.nope=5"])
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"not_a_rule": {}}))
+    with pytest.raises(AlertTreeError):
+        load_rules(str(bad))
+    # a typo'd PARAM in the file is just as loud as a typo'd rule —
+    # it must not silently leave the real threshold at its default
+    typo = tmp_path / "typo.json"
+    typo.write_text(json.dumps({"stale_heartbeat": {"max_age_sec": 5}}))
+    with pytest.raises(AlertTreeError):
+        load_rules(str(typo))
+
+
+def test_disabled_rule_does_not_fire(tree):
+    store, queue = tree
+    status(store, "dead", state="serving", hb_age=300.0)
+    rules = load_rules(sets=["stale_heartbeat.enabled=false"])
+    assert evaluate([store], [queue], rules=rules, now=NOW) == []
+
+
+# -- the firing/resolved state machine ---------------------------------------
+
+def _alert(key="slo_burn:o1", value=4.0):
+    rule, subject = key.split(":")
+    return Alert(rule, subject, "page", value, 2.0, f"{subject} burning")
+
+
+def test_state_machine_fire_dedup_resolve_refire(tmp_path):
+    path = str(tmp_path / "alerts.json")
+    book = AlertBook(path, owner="t", resolve_hold_secs=0.0)
+    # fire
+    doc = book.apply([_alert()], now=NOW)
+    e = doc["alerts"]["slo_burn:o1"]
+    assert doc["firing"] == ["slo_burn:o1"]
+    assert e["state"] == "firing" and e["count"] == 1
+    assert e["first_fired_at"] == NOW
+    assert e["transitions"] == [{"to": "firing", "at": NOW}]
+    # still firing: dedup — observation refreshed, NO new transition
+    doc = book.apply([_alert(value=5.0)], now=NOW + 10)
+    e = doc["alerts"]["slo_burn:o1"]
+    assert e["count"] == 1 and len(e["transitions"]) == 1
+    assert e["value"] == 5.0 and e["last_seen_at"] == NOW + 10
+    assert e["first_fired_at"] == NOW
+    # absent: resolved, timestamped
+    doc = book.apply([], now=NOW + 20)
+    e = doc["alerts"]["slo_burn:o1"]
+    assert e["state"] == "resolved" and e["resolved_at"] == NOW + 20
+    assert doc["firing"] == []
+    assert [t["to"] for t in e["transitions"]] == ["firing", "resolved"]
+    # re-fire: visibly a re-fire (count 2, first_fired_at preserved)
+    doc = book.apply([_alert()], now=NOW + 30)
+    e = doc["alerts"]["slo_burn:o1"]
+    assert e["state"] == "firing" and e["count"] == 2
+    assert e["first_fired_at"] == NOW
+    assert [t["to"] for t in e["transitions"]] == \
+        ["firing", "resolved", "firing"]
+    # the ledger round-trips through disk (a fresh book sees the state)
+    doc2 = AlertBook(path, owner="t").load()
+    assert doc2["alerts"]["slo_burn:o1"]["count"] == 2
+
+
+def test_state_machine_resolve_hysteresis_no_flap(tmp_path):
+    book = AlertBook(str(tmp_path / "alerts.json"), resolve_hold_secs=60.0)
+    book.apply([_alert()], now=NOW)
+    # absent, but inside the hold window: keeps firing (no flap)
+    doc = book.apply([], now=NOW + 30)
+    assert doc["alerts"]["slo_burn:o1"]["state"] == "firing"
+    # flapping back in is a dedup, not a transition
+    doc = book.apply([_alert()], now=NOW + 40)
+    e = doc["alerts"]["slo_burn:o1"]
+    assert e["count"] == 1 and len(e["transitions"]) == 1
+    # absent past the hold: resolved exactly once
+    doc = book.apply([], now=NOW + 101)
+    assert doc["alerts"]["slo_burn:o1"]["state"] == "resolved"
+
+
+def test_state_machine_survives_torn_ledger(tmp_path):
+    path = str(tmp_path / "alerts.json")
+    open(path, "w").write('{"torn')
+    doc = AlertBook(path).apply([_alert()], now=NOW)
+    assert doc["firing"] == ["slo_burn:o1"]
+
+
+# -- the check CLI (the CI gate) ---------------------------------------------
+
+def _check(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tenzing_tpu.obs.alerts", "check", *args],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_check_cli_exit_codes(tree, tmp_path):
+    store, queue = tree
+    now = time.time()
+    snap(store, "ok", 0, 90.0, now=now)
+    status(store, "ok", state="serving", hb_age=0.0, now=now)
+    state = str(tmp_path / "ledger.json")
+    r = _check("--store", store, "--queue-dir", queue, "--state", state)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["n_firing"] == 0
+    # doctor the tree: pct99 10x over the SLO, sustained across the ring
+    for i in range(4):
+        snap(store, "ok", i, 1000.0, now=now)
+    r = _check("--store", store, "--queue-dir", queue, "--state", state)
+    assert r.returncode == 1, r.stdout
+    out = json.loads(r.stdout)
+    assert out["n_firing"] == 1
+    assert out["firing"][0]["rule"] == "slo_burn"
+    ledger = json.load(open(state))
+    assert ledger["firing"] == ["slo_burn:ok"]
+    # heal: the same ledger resolves the alert, exit back to 0
+    for i in range(4):
+        snap(store, "ok", i, 90.0, now=now)
+    r = _check("--store", store, "--queue-dir", queue, "--state", state)
+    assert r.returncode == 0, r.stdout
+    ledger = json.load(open(state))
+    assert ledger["alerts"]["slo_burn:ok"]["state"] == "resolved"
+    # unreadable tree = usage error, not a verdict
+    r = _check("--store", str(tmp_path / "missing"))
+    assert r.returncode == 2
+    assert "not a directory" in r.stderr
+    # so is a malformed override
+    r = _check("--store", store, "--set", "bogus.x=1")
+    assert r.returncode == 2
+    # and an unwritable ledger: a broken watchtower must never read as
+    # "alerts firing" (exit 1) to the CI gate
+    not_a_dir = str(tmp_path / "file")
+    open(not_a_dir, "w").write("x")
+    r = _check("--store", store, "--state",
+               os.path.join(not_a_dir, "alerts.json"))
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+
+
+def test_follow_renders_firing_lines(tree):
+    store, queue = tree
+    for i, p in enumerate([400.0, 410.0, 420.0, 430.0]):
+        snap(store, "burn", i, p)
+    lines = firing_lines([store], [queue])
+    assert len(lines) == 1
+    assert lines[0].startswith("ALERT  [page] slo_burn burn:")

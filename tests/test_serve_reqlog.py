@@ -1,0 +1,300 @@
+"""Watchtower data plane (ISSUE 13; docs/observability.md
+"Watchtower"): the production request log — deterministic sampling,
+sealed-segment publish, salvage-on-damage reads, rotation with a
+retention cap, the observable position block — and the tail-sampled
+exemplar store (slowest-K per window, interesting outcomes kept
+immediately, bounded files, stitchable bundles).
+"""
+
+import json
+import os
+
+import pytest
+
+from tenzing_tpu.serve.reqlog import (
+    ExemplarStore,
+    RequestLog,
+    read_exemplars,
+    read_request_log,
+    record_digest,
+    sampled_in,
+)
+
+
+def _rec(i, tier="exact", outcome="served", trace=None, ts=None):
+    return {"v": 1, "ts": 1000.0 + i * 0.01 if ts is None else ts,
+            "trace_id": trace or f"{i:016x}", "tenant": "t", "op": "query",
+            "outcome": outcome, "tier": tier, "workload": "spmv",
+            "exact": "e" * 12, "bucket": "b" * 12,
+            "resolve_us": 100.0 + i,
+            "request": {"workload": "spmv", "m": 512 + i}}
+
+
+# -- request log -------------------------------------------------------------
+
+def test_append_publish_read_roundtrip(tmp_path):
+    d = str(tmp_path / "rl")
+    rl = RequestLog(d, owner="t1", segment_records=4)
+    for i in range(10):
+        assert rl.append(_rec(i)) is True
+    rl.flush()
+    pos = rl.position()
+    assert pos["records"] == 10 and pos["buffered"] == 0
+    assert pos["segments"] == 3  # 4 + 4 + flush(2)
+    data = read_request_log(d)
+    assert len(data["records"]) == 10
+    assert data["segments"] == 3
+    assert data["damaged"] == 0 and data["checksum_failed"] == 0
+    # ts-ordered, kwargs verbatim
+    ts = [r["ts"] for r in data["records"]]
+    assert ts == sorted(ts)
+    assert data["records"][3]["request"] == {"workload": "spmv", "m": 515}
+
+
+def test_full_buffer_rotates_without_request_path_io(tmp_path):
+    """A full buffer becomes a PENDING sealed batch with zero I/O on
+    the appending (request-path) thread; the heartbeat-side
+    publish_pending pays the fsyncs — unless the pending backlog blows
+    the cap, where inline publish (backpressure) beats unbounded
+    memory."""
+    d = str(tmp_path / "rl")
+    rl = RequestLog(d, owner="t1", segment_records=2,
+                    pending_batch_cap=2)
+    for i in range(4):
+        rl.append(_rec(i))
+    assert not os.path.exists(d)  # two batches pending, no file yet
+    assert rl.position()["buffered"] == 4
+    assert rl.publish_pending() == 2
+    assert rl.position()["buffered"] == 0
+    assert len(read_request_log(d)["records"]) == 4
+    # storm: the 3rd rotation exceeds cap=2 -> published inline
+    for i in range(6):
+        rl.append(_rec(10 + i))
+    assert rl.position()["segments"] >= 5
+    assert rl.position()["buffered"] == 0
+
+
+def test_sampling_deterministic_and_counted(tmp_path):
+    traces = [f"{i:016x}" for i in range(200)]
+    kept = {t for t in traces if sampled_in(t, 0.5)}
+    # the draw is a stable hash: same verdicts on a second evaluation,
+    # and roughly half the population admitted
+    assert kept == {t for t in traces if sampled_in(t, 0.5)}
+    assert 60 <= len(kept) <= 140
+    assert all(sampled_in(t, 1.0) for t in traces)
+    assert not any(sampled_in(t, 0.0) for t in traces)
+    d = str(tmp_path / "rl")
+    rl = RequestLog(d, owner="t1", sample=0.5, segment_records=1000)
+    n_in = sum(1 for i, t in enumerate(traces)
+               if rl.append(_rec(i, trace=t)))
+    assert n_in == len(kept)
+    pos = rl.position()
+    assert pos["dropped_sampling"] == len(traces) - len(kept)
+    rl.flush()
+    data = read_request_log(d)
+    # coverage is reconstructable from the log alone: the header's
+    # cumulative dropped count survives the writer process
+    assert data["dropped_sampling"] == len(traces) - len(kept)
+    assert len(data["records"]) == len(kept)
+
+
+def test_dropped_sampling_sums_across_writers(tmp_path):
+    """Two loops recording into one directory: each header's cumulative
+    drop count is per-writer — max within an owner, summed across them
+    (one writer's coverage must not shadow the other's)."""
+    d = str(tmp_path / "rl")
+    for owner, n_drop in (("w1", 3), ("w2", 5)):
+        rl = RequestLog(d, owner=owner, sample=0.0)
+        for i in range(n_drop):
+            assert rl.append(_rec(i)) is False
+        rl.sample = 1.0
+        rl.append(_rec(99, trace=owner * 8))
+        rl.flush()
+    data = read_request_log(d)
+    assert data["dropped_sampling"] == 8
+    assert len(data["records"]) == 2
+
+
+def test_unserializable_record_coerced_not_fatal(tmp_path):
+    """A stray non-JSON value in request kwargs must cost a lossless-ish
+    coercion (default=str), never the segment publish — one poisoned
+    record must not discard the rest of the buffer."""
+    d = str(tmp_path / "rl")
+    rl = RequestLog(d, owner="t1", segment_records=100)
+    rec = _rec(0)
+    rec["request"]["blob"] = b"\x00raw"
+    assert rl.append(rec) is True
+    rl.append(_rec(1))
+    rl.flush()
+    data = read_request_log(d)
+    assert len(data["records"]) == 2 and data["damaged"] == 0
+    assert isinstance(data["records"][0]["request"]["blob"], str)
+
+
+def test_rotation_retention_cap(tmp_path):
+    d = str(tmp_path / "rl")
+    rl = RequestLog(d, owner="t1", segment_records=1, retain_segments=3)
+    for i in range(6):
+        rl.append(_rec(i))
+    rl.flush()
+    names = [n for n in os.listdir(d) if n.endswith(".jsonl")]
+    assert len(names) == 3
+    assert rl.position()["segments_reclaimed"] == 3
+    data = read_request_log(d)
+    # the newest 3 records survive the rotation
+    assert [r["request"]["m"] for r in data["records"]] == [515, 516, 517]
+
+
+def test_salvage_on_damage(tmp_path):
+    d = str(tmp_path / "rl")
+    rl = RequestLog(d, owner="t1", segment_records=2)
+    for i in range(6):
+        rl.append(_rec(i))
+    rl.flush()
+    names = sorted(n for n in os.listdir(d) if n.endswith(".jsonl"))
+    assert len(names) == 3
+    # bit-flip one record (checksum mismatch)
+    p0 = os.path.join(d, names[0])
+    lines = open(p0).read().splitlines()
+    bad = json.loads(lines[1])
+    bad["record"]["resolve_us"] = 999999.0  # checksum now stale
+    lines[1] = json.dumps(bad, sort_keys=True)
+    open(p0, "w").write("\n".join(lines) + "\n")
+    # torn tail line on another
+    p1 = os.path.join(d, names[1])
+    open(p1, "a").write('{"sha256": "zz", "reco')
+    # truncation on the third (drop the last line below the header count)
+    p2 = os.path.join(d, names[2])
+    lines2 = open(p2).read().splitlines()
+    open(p2, "w").write("\n".join(lines2[:-1]) + "\n")
+    data = read_request_log(d)
+    assert data["checksum_failed"] == 1
+    assert data["torn_lines"] == 1
+    assert data["damaged"] == 3
+    # every checksum-valid record salvaged: 6 - 1 flipped - 1 truncated
+    assert len(data["records"]) == 4
+    # read-only: nothing quarantined or renamed
+    assert sorted(n for n in os.listdir(d) if n.endswith(".jsonl")) == names
+
+
+def test_newer_version_skipped_loudly(tmp_path):
+    d = str(tmp_path / "rl")
+    os.makedirs(d)
+    header = {"kind": "reqlog_segment", "version": 99, "n_records": 1}
+    rec = _rec(0)
+    body = json.dumps(header) + "\n" + json.dumps(
+        {"sha256": record_digest(rec), "record": rec}) + "\n"
+    open(os.path.join(d, "req-1-x-1.jsonl"), "w").write(body)
+    notes = []
+    data = read_request_log(d, log=notes.append)
+    assert data["newer_skipped"] == 1
+    assert data["records"] == []  # future data is not readable data
+    assert any("newer version" in n for n in notes)
+
+
+def test_reader_missing_dir_raises(tmp_path):
+    with pytest.raises(OSError):
+        read_request_log(str(tmp_path / "nope"))
+
+
+# -- exemplars ---------------------------------------------------------------
+
+def test_interesting_outcomes_written_immediately(tmp_path):
+    d = str(tmp_path / "ex")
+    ex = ExemplarStore(d, k=2)
+    p = ex.offer(_rec(0, outcome="shed", trace="aa" * 8),
+                 interesting="shed")
+    assert p is not None and os.path.exists(p)
+    ex.offer(_rec(1, outcome="timeout", trace="bb" * 8),
+             interesting="timeout")
+    headers = read_exemplars(d)
+    assert {h["reason"] for h in headers} == {"shed", "timeout"}
+    assert headers[0]["record"]["request"]["workload"] == "spmv"
+    assert ex.written == 2
+
+
+def test_slowest_k_per_window(tmp_path):
+    d = str(tmp_path / "ex")
+    ex = ExemplarStore(d, k=2)
+    for i, us in enumerate([50, 900, 120, 80, 700, 60]):
+        rec = _rec(i, trace=f"{i:02d}" * 8)
+        rec["resolve_us"] = float(us)
+        assert ex.offer(rec) is None  # candidates buffer until the roll
+    assert read_exemplars(d) == []
+    written = ex.roll()
+    assert len(written) == 2
+    headers = read_exemplars(d)
+    assert all(h["reason"] == "slow" for h in headers)
+    assert sorted(h["record"]["resolve_us"] for h in headers) == [700, 900]
+    # the window closed: a second roll writes nothing new
+    assert ex.roll() == []
+
+
+def test_exemplars_sharing_a_trace_do_not_overwrite(tmp_path):
+    """Every member of a shed/errored batch carries the pending's ONE
+    trace_id; each must land its own bundle (and be counted once)."""
+    d = str(tmp_path / "ex")
+    ex = ExemplarStore(d, cap=8)
+    paths = [ex.offer(_rec(i, trace="ab" * 8), interesting="shed")
+             for i in range(3)]
+    assert len(set(paths)) == 3
+    assert len(read_exemplars(d)) == 3
+    assert ex.written == 3
+
+
+def test_exemplar_immediate_budget_bounds_a_shed_storm(tmp_path):
+    """Interesting outcomes write on the request path — a shed storm
+    must cost at most the per-window budget in bundle writes (the rest
+    counted suppressed), and the budget refills at the roll."""
+    d = str(tmp_path / "ex")
+    ex = ExemplarStore(d, k=1, cap=64, immediate_per_window=3)
+    written = [ex.offer(_rec(i, trace=f"{i:02d}" * 8),
+                        interesting="shed") for i in range(10)]
+    assert sum(1 for p in written if p) == 3
+    assert ex.suppressed == 7
+    assert len(read_exemplars(d)) == 3
+    ex.roll()  # window closes: the budget refills
+    assert ex.offer(_rec(11, trace="ee" * 8),
+                    interesting="timeout") is not None
+
+
+def test_exemplar_cap_eviction(tmp_path):
+    d = str(tmp_path / "ex")
+    ex = ExemplarStore(d, k=1, cap=3)
+    for i in range(5):
+        p = ex.offer(_rec(i, trace=f"{i:02d}" * 8), interesting="error")
+        os.utime(p, (1000 + i, 1000 + i))  # distinct mtimes for eviction
+    files = [n for n in os.listdir(d) if n.startswith("exemplar-")]
+    assert len(files) == 3
+    # newest-by-mtime survive
+    assert any("0404" in n for n in files)
+    assert not any("0000" in n for n in files)
+
+
+def test_exemplar_bundle_carries_trace_spans_and_stitches(tmp_path):
+    from tenzing_tpu.obs import context as obs_context
+    from tenzing_tpu.obs.export import read_jsonl, stitch_records
+    from tenzing_tpu.obs.tracer import Tracer
+
+    tracer = Tracer(enabled=True)
+    ctx = obs_context.new_trace()
+    with obs_context.use(ctx):
+        with tracer.span("serve.query", tier="exact"):
+            pass
+    with tracer.span("unrelated.span"):
+        pass
+    d = str(tmp_path / "ex")
+    ex = ExemplarStore(d, tracer=tracer)
+    rec = _rec(0, trace=ctx.trace_id)
+    path = ex.offer(rec, interesting="timeout")
+    recs = read_jsonl(path)
+    # line 0 the header, then ONLY this trace's span records
+    assert recs[0]["kind"] == "exemplar"
+    assert recs[0]["trace_id"] == ctx.trace_id
+    spans = [r for r in recs[1:] if r.get("kind") == "span"]
+    assert [s["name"] for s in spans] == ["serve.query"]
+    assert read_exemplars(d)[0]["n_trace_records"] == 1
+    # directly stitchable: the header line is skipped, the span merges
+    _, summary = stitch_records([("exemplar", recs)])
+    assert ctx.trace_id in summary["traces"]
+    assert "serve.query" in summary["traces"][ctx.trace_id]["names"]
