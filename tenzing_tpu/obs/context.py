@@ -41,11 +41,42 @@ from typing import Any, Dict, Iterator, Optional
 TRACE_ENV = "TENZING_TRACE_CONTEXT"
 
 
+# minting entropy is buffered: context ids come from ``os.urandom`` —
+# never ``random``, so the solvers' seeded RNG streams stay untouched —
+# but one urandom *syscall* per id is real microseconds on the serving
+# ingress (each request mints two).  One 4 KiB read amortizes the
+# syscall over 256 mints; the buffer is reset in a forked child so two
+# processes can never replay the same entropy window.
+_MINT_REFILL = 4096
+_mint_lock = threading.Lock()
+_mint_buf = b""
+_mint_pos = 0
+
+
+def _mint_reset() -> None:
+    global _mint_lock, _mint_buf, _mint_pos
+    # rebind the lock too: a child forked while another thread held it
+    # would otherwise deadlock on its first mint
+    _mint_lock = threading.Lock()
+    _mint_buf = b""
+    _mint_pos = 0
+
+
+if hasattr(os, "register_at_fork"):  # pragma: no branch
+    os.register_at_fork(after_in_child=_mint_reset)
+
+
 def _mint_id(nbytes: int = 8) -> str:
-    """A random hex id (default 16 hex chars) — ``os.urandom``, not
-    ``random``: context minting must never perturb (or depend on) the
-    seeded RNG streams the solvers replay deterministically."""
-    return os.urandom(nbytes).hex()
+    """A random hex id (default 16 hex chars) from the buffered urandom
+    pool (module comment above)."""
+    global _mint_buf, _mint_pos
+    with _mint_lock:
+        if _mint_pos + nbytes > len(_mint_buf):
+            _mint_buf = os.urandom(max(_MINT_REFILL, nbytes))
+            _mint_pos = 0
+        out = _mint_buf[_mint_pos:_mint_pos + nbytes]
+        _mint_pos += nbytes
+    return out.hex()
 
 
 @dataclass(frozen=True)

@@ -633,13 +633,44 @@ def segment_lines(st: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def _fastpath_rates(counters: Dict[str, Any]) -> Optional[str]:
+    """The fast-path economics line (docs/serving.md "Fast path") from
+    a metric snapshot's counter block: memo and fingerprint-cache hit
+    rates + memo invalidations.  None when the process never served
+    through either cache (nothing to rate)."""
+    def rate(hits_key, misses_key):
+        h = counters.get(hits_key, 0)
+        m = counters.get(misses_key, 0)
+        return (h, m, h / (h + m)) if (h + m) else None
+
+    memo = rate("serve.memo.hits", "serve.memo.misses")
+    fpc = rate("serve.fp_cache.hits", "serve.fp_cache.misses")
+    if memo is None and fpc is None:
+        return None
+    parts = []
+    if memo is not None:
+        parts.append(f"memo hit rate {memo[2]:.1%} "
+                     f"({memo[0]}/{memo[0] + memo[1]}, "
+                     f"{counters.get('serve.memo.invalidations', 0)} "
+                     "invalidated)")
+    if fpc is not None:
+        parts.append(f"fp-cache hit rate {fpc[2]:.1%} "
+                     f"({fpc[0]}/{fpc[0] + fpc[1]})")
+    return "fast path: " + ", ".join(parts)
+
+
 def serve_status_lines(store_dir: str) -> List[str]:
     """Serve-loop status documents (serve/listen.py ``status-*.json``)
     found in a segmented store directory: liveness staleness + the
     served/shed/timeout economics — the same probe-target treatment the
-    queue section gives daemon status docs."""
+    queue section gives daemon status docs.  Each loop's fast-path
+    cache economics (memo + fingerprint-cache hit rates) render from
+    its newest metric snapshot."""
     import time as _time
 
+    from tenzing_tpu.obs.metrics import latest_snapshots
+
+    snapshots = latest_snapshots(store_dir)
     lines: List[str] = []
     now = _time.time()
     for name in sorted(os.listdir(store_dir)):
@@ -663,6 +694,12 @@ def serve_status_lines(store_dir: str) -> List[str]:
             f"{c.get('served_cold', 0)}), shed {c.get('shed', 0)}, "
             f"timeouts {c.get('timeouts', 0)}, queue depth "
             f"{st.get('queue_depth', 0)}")
+        snap = snapshots.get(st.get("owner"))
+        if snap:
+            rates = _fastpath_rates(
+                (snap.get("metrics") or {}).get("counters") or {})
+            if rates:
+                lines.append(f"  - {rates}")
     if lines:
         lines.append("")
     return lines

@@ -33,6 +33,10 @@ existing offline pieces behind a request/response API:
   retries, poison quarantine, status/heartbeat JSON — the
   serve→search→serve loop closed end-to-end (docs/serving.md
   "Drain daemon").
+* :mod:`~tenzing_tpu.serve.fleet` — N daemons work-stealing one queue
+  (``python -m tenzing_tpu.serve.fleet``): the launcher, the
+  exactly-once double-run audit, and the drain-rate scaling harness
+  (docs/serving.md "Drain fleet").
 
 Workflow and formats: docs/serving.md.  Telemetry: ``serve.*`` counters
 (hit/near/cold), the ``serve.resolve_us`` latency histogram, and
@@ -46,15 +50,20 @@ from tenzing_tpu.serve.fingerprint import (
     schedule_key,
     shape_bucket,
 )
-from tenzing_tpu.serve.resolver import Resolution, Resolver
+from tenzing_tpu.serve.fleet import FleetOpts, measure_scaling, run_fleet
+from tenzing_tpu.serve.resolver import Resolution, Resolver, fp_cache_key
 from tenzing_tpu.serve.service import ScheduleService
 from tenzing_tpu.serve.store import ScheduleStore, WorkQueue, merge_records
 
 __all__ = [
     "DaemonOpts",
     "DrainDaemon",
+    "FleetOpts",
     "Resolution",
     "Resolver",
+    "fp_cache_key",
+    "measure_scaling",
+    "run_fleet",
     "ScheduleService",
     "ScheduleStore",
     "WorkQueue",

@@ -149,6 +149,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     pl.add_argument("--request-timeout", type=float, default=10.0,
                     metavar="SECS",
                     help="per-request watchdog (0 disables)")
+    pl.add_argument("--tenant-max-pending", type=int, default=None,
+                    help="per-tenant in-flight cap: an over-cap tenant "
+                         "is shed with reason tenant_cap before the "
+                         "global bound fills (default max-pending/2; "
+                         "0 disables)")
     pl.add_argument("--shed-retry-after", type=float, default=0.5,
                     metavar="SECS",
                     help="retry_after hint carried by shed responses")
@@ -241,6 +246,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         opts = ListenOpts(
             max_pending=args.max_pending, workers=args.workers,
+            tenant_max_pending=args.tenant_max_pending,
             request_timeout_secs=args.request_timeout or 0.0,
             shed_retry_after_secs=args.shed_retry_after,
             heartbeat_secs=args.heartbeat,

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass
+from functools import cached_property
 from typing import Any, Dict, Tuple
 
 from tenzing_tpu.bench.model import ICI_KINDS, PCIE_KINDS
@@ -90,12 +91,18 @@ class WorkloadFingerprint:
             "engines": [[k, list(v)] for k, v in self.engines],
         }))
 
-    @property
+    # cached: one resolution touches each digest several times (cache
+    # probe, span attributes, response serialization), and each compute
+    # is a canonical-JSON dump + sha1 — real microseconds on the
+    # serving hot path.  ``cached_property`` stores into ``__dict__``
+    # directly, which a frozen dataclass permits; the fingerprint is
+    # immutable, so the cache can never go stale.
+    @cached_property
     def exact_digest(self) -> str:
         """Keys exact hits: precise shape."""
         return self._digest(self.shape)
 
-    @property
+    @cached_property
     def bucket_digest(self) -> str:
         """Keys the near-miss neighborhood: bucketed shape."""
         return self._digest(self.bucket)

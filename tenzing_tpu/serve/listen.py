@@ -23,6 +23,19 @@ load-shedding request loop (docs/serving.md "Listen mode"):
   letting every client time out in line (the same honesty rule as the
   near tier's uncertainty gate: a non-answer now beats a bad answer
   later).
+* **Per-tenant fair admission** — ``--tenant-max-pending`` (default
+  half the global bound, 0 disables) caps each tenant's own in-flight
+  count: an over-cap tenant sheds with ``{"shed": true, "reason":
+  "tenant_cap"}`` *before* its burst can fill the global bound and
+  starve everyone else; the per-tenant ``serve.shed.<tenant>``
+  counters are the fairness measurement.  Untagged requests see only
+  the global bound.
+* **Split resolve lock** (docs/serving.md "Fast path") — workers try
+  the resolver's lock-free snapshot path first (exact hits resolve
+  CONCURRENTLY, memoized response and all); only the fallback — store
+  walks, flag writes, cold enqueues, the near tier — serializes under
+  the exclusive lock, so exact-tier pct99 at high QPS is bounded by
+  the hit's own microseconds, not queue depth.
 * **Per-request watchdog** — a request older than
   ``--request-timeout`` is answered with a classified timeout
   (``error_class: transient`` — the fault taxonomy of
@@ -85,6 +98,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from tenzing_tpu.fault.errors import classify_error
 from tenzing_tpu.obs import context as obs_context
+from tenzing_tpu.serve.resolver import fp_cache_key
 from tenzing_tpu.obs.metrics import (
     MetricsSnapshotWriter,
     SloConfig,
@@ -121,6 +135,16 @@ class ListenOpts:
     # aggregate under "other" — per-tenant series must not let a
     # client-controlled string grow the registry without bound
     tenant_cap: int = 16
+    # per-tenant fair admission (docs/serving.md): at most this many
+    # in-flight requests per tenant (batch members charged to their
+    # effective tenants) — an over-cap tenant is shed with reason
+    # "tenant_cap" BEFORE its burst can fill the global max_pending
+    # bound and starve everyone else.  None derives
+    # max(1, max_pending // 2), enforced work-conservingly (only once a
+    # second distinct tenant is seen); 0 disables the cap; an explicit
+    # value always applies.  Requests without a tenant tag see only the
+    # global bound.
+    tenant_max_pending: Optional[int] = None
     # -- watchtower: production traffic recording (serve/reqlog.py) --
     record_dir: Optional[str] = None     # enables the request log
     record_sample: float = 1.0           # deterministic per-trace draw
@@ -202,6 +226,12 @@ class ServeLoop:
             maxsize=max(1, self.opts.max_pending))
         self._live: "set[_Pending]" = set()
         self._live_lock = threading.Lock()
+        # per-tenant in-flight counts, maintained under _live_lock by
+        # _live_add/_live_discard: the fair-admission check is O(1) and
+        # ATOMIC with registration — concurrent submits from many
+        # connection threads cannot race past the cap between a count
+        # and an add
+        self._tenant_live: Dict[str, int] = {}
         # resolution is serialized: the resolver's caches and the store
         # flag/enqueue writes are not thread-safe, and the hot path is a
         # dict probe — worker concurrency buys queueing, not resolution
@@ -313,27 +343,37 @@ class ServeLoop:
         if self._stop.is_set():
             self._shed(pending, reason="draining")
             return
-        # registered live BEFORE the enqueue: a worker that grabs the
-        # item instantly must find it registered, or the discard would
-        # lose to the add and leak a ghost into the watchdog's view
-        with self._live_lock:
-            self._live.add(pending)
+        # per-tenant fair admission, atomic with live registration: the
+        # tenant's own in-flight count is bounded below the global one,
+        # so one tenant's burst sheds against its own cap while everyone
+        # else still has queue room.  Registered live BEFORE the
+        # enqueue: a worker that grabs the item instantly must find it
+        # registered, or the discard would lose to the add and leak a
+        # ghost into the watchdog's view.
+        admitted, over_tenant = self._live_add(pending)
+        if not admitted:
+            self._shed(pending, reason="tenant_cap", tenant=over_tenant)
+            return
         try:
             self._queue.put_nowait(pending)
         except _queue.Full:
-            with self._live_lock:
-                self._live.discard(pending)
+            self._live_discard(pending)
             self._shed(pending, reason="queue-full")
             return
 
-    def _shed(self, pending: _Pending, reason: str) -> None:
+    def _shed(self, pending: _Pending, reason: str,
+              tenant: Optional[str] = None) -> None:
         self._bump("shed")
         reg = get_metrics()
         reg.counter("serve.shed").inc()
         # per-tenant shed economics (ISSUE 13 satellite): the fairness
         # measurement the ROADMAP's per-tenant admission item needs —
-        # capped to "other" exactly like the latency series
-        label = self._tenant_label(self._tenant_of(pending.payload))
+        # capped to "other" exactly like the latency series.  ``tenant``
+        # names the over-cap tenant for tenant_cap sheds (an untagged
+        # batch shed for a MEMBER tenant must charge that tenant, not
+        # nobody); other reasons attribute to the payload tenant.
+        label = self._tenant_label(tenant if tenant is not None
+                                   else self._tenant_of(pending.payload))
         if label is not None:
             reg.counter(f"serve.shed.{label}").inc()
         tr = get_tracer()
@@ -346,6 +386,98 @@ class ServeLoop:
             "error_class": "transient"}
         if pending.complete(doc):
             self._record(pending, doc)
+
+    def _tenant_pending_cap(self) -> int:
+        """The effective per-tenant in-flight bound (opts docstring):
+        configured, or half the global bound; 0 = disabled."""
+        cap = self.opts.tenant_max_pending
+        if cap is None:
+            return max(1, self.opts.max_pending // 2)
+        return max(0, cap)
+
+    @classmethod
+    def _tenant_weights(cls, payload: Any) -> Dict[str, int]:
+        """tenant -> request count a payload charges against the
+        fair-admission cap.  Tenant tags are guarded to strings (client
+        input — a non-string tenant must not crash admission on an
+        unhashable dict key; it admits uncapped like an untagged
+        request, same rule as ``_tenant_label``).  A batch charges each
+        MEMBER to its own effective tenant — the same ``r.get("tenant",
+        payload_tenant)`` rule execution and telemetry apply — so
+        neither one batch slot nor member-level tagging can smuggle
+        sub-requests past the starvation bound.  Pure payload
+        arithmetic: add and discard recompute it identically, so no
+        per-pending state is needed."""
+        base = cls._tenant_of(payload)
+        if not isinstance(base, str):
+            base = None
+        if not (isinstance(payload, dict)
+                and payload.get("op") == "batch"):
+            return {base: 1} if base else {}
+        reqs = payload.get("requests")
+        if not isinstance(reqs, list) or not reqs:
+            return {base: 1} if base else {}
+        weights: Dict[str, int] = {}
+        for r in reqs:
+            t = r.get("tenant", base) if isinstance(r, dict) else base
+            if not isinstance(t, str):
+                t = None
+            if t:
+                weights[t] = weights.get(t, 0) + 1
+        return weights
+
+    def _live_add(self, pending: _Pending):
+        """Register a request in the live set, enforcing the per-tenant
+        cap atomically in the same critical section.  Returns
+        ``(admitted, over_tenant)``: ``(False, <tenant>)`` means that
+        tenant is over cap and the request was NOT registered (the
+        caller sheds with reason ``tenant_cap``, charged to that
+        tenant; a batch admits or sheds whole — it occupies one queue
+        slot).
+
+        The DERIVED default cap (no explicit ``tenant_max_pending``) is
+        work-conserving: it only bites once a second distinct tenant
+        has been seen (``self._tenants`` — shed and resolution labeling
+        both register tenants, so a starved newcomer activates the cap
+        within one round-trip).  Fairness between tenants is vacuous
+        with one tenant, and halving a sole tenant's capacity against
+        nobody would be pure waste.  An explicit cap always applies."""
+        weights = self._tenant_weights(pending.payload)
+        for t in weights:
+            # register at submission so a starved newcomer activates
+            # the derived cap immediately, not only after it resolves
+            self._tenant_label(t)
+        cap = self._tenant_pending_cap()
+        if cap and self.opts.tenant_max_pending is None and \
+                len(self._tenants) < 2:
+            cap = 0
+        with self._live_lock:
+            if cap:
+                for tenant, weight in weights.items():
+                    if self._tenant_live.get(tenant, 0) + weight > cap:
+                        return False, tenant
+            self._live.add(pending)
+            for tenant, weight in weights.items():
+                self._tenant_live[tenant] = \
+                    self._tenant_live.get(tenant, 0) + weight
+        return True, None
+
+    def _live_discard(self, pending: _Pending) -> None:
+        """Remove from the live set, keeping the per-tenant counts
+        exact: both the worker and the watchdog discard the same
+        pending, so only the acquisition that actually removes it may
+        decrement."""
+        with self._live_lock:
+            if pending not in self._live:
+                return
+            self._live.discard(pending)
+            for tenant, weight in \
+                    self._tenant_weights(pending.payload).items():
+                n = self._tenant_live.get(tenant, 0) - weight
+                if n > 0:
+                    self._tenant_live[tenant] = n
+                else:
+                    self._tenant_live.pop(tenant, None)
 
     # -- workers -------------------------------------------------------------
     @staticmethod
@@ -369,14 +501,35 @@ class ServeLoop:
 
     def _resolve_one(self, request: Dict[str, Any],
                      tenant: Optional[str] = None) -> Dict[str, Any]:
-        from tenzing_tpu.bench.driver import DriverRequest
-
-        with self._resolve_lock:
-            # timed inside the lock: resolve_us is the resolution's own
-            # latency (the serve.resolve_us series), not queue/lock wait
-            t0 = time.perf_counter()
-            res = self.service.query(DriverRequest(**(request or {})))
+        # the split lock (docs/serving.md "Fast path"): exact hits
+        # resolve lock-free against the resolver's immutable snapshot —
+        # workers serve them CONCURRENTLY — and only the fallback
+        # (store writes, cold enqueues, the near tier, cache refills)
+        # takes the exclusive lock.  pct99 at high QPS is then bounded
+        # by the hit's own microseconds, not by queue depth times the
+        # slowest request ahead of it.
+        # embedded/stub services without a resolver attribute keep the
+        # pre-split behavior: everything through the exclusive lock
+        resolver = getattr(self.service, "resolver", None)
+        key = (fp_cache_key(request if request else {})
+               if resolver is not None else None)
+        t0 = time.perf_counter()
+        res = resolver.resolve_fast(key) if resolver is not None else None
+        if res is not None:
             dt_us = (time.perf_counter() - t0) * 1e6
+        else:
+            from tenzing_tpu.bench.driver import DriverRequest
+
+            with self._resolve_lock:
+                # timed inside the lock: resolve_us is the resolution's
+                # own latency (the serve.resolve_us series), not
+                # queue/lock wait
+                t0 = time.perf_counter()
+                req = DriverRequest(**(request or {}))
+                res = (self.service.query(req, fp_key=key)
+                       if resolver is not None
+                       else self.service.query(req))
+                dt_us = (time.perf_counter() - t0) * 1e6
         # response serialization is a real per-hit phase (the ROADMAP's
         # tens-of-µs item profiles it): timed + sub-spanned like the
         # resolver's fingerprint/cache-probe phases
@@ -460,8 +613,7 @@ class ServeLoop:
                 if pending.complete(doc):
                     self._record(pending, doc)
             finally:
-                with self._live_lock:
-                    self._live.discard(pending)
+                self._live_discard(pending)
                 self._queue.task_done()
 
     def _watchdog(self) -> None:
@@ -488,8 +640,7 @@ class ServeLoop:
                     if label is not None:
                         reg.counter(f"serve.timeout.{label}").inc()
                     self._record(p, doc)
-                with self._live_lock:
-                    self._live.discard(p)
+                self._live_discard(p)
             # sleep on ABANDON, not stop: once stop is set (the whole
             # drain window) a stop.wait would return instantly and this
             # loop would spin a core while contending _live_lock

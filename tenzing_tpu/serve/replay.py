@@ -60,12 +60,19 @@ from typing import Any, Dict, List, Optional, Tuple
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.utils.numeric import percentile
 
-REPLAY_VERSION = 3
+REPLAY_VERSION = 4
 # raw exact-tier latency series retained in the result document (replay
 # order preserved): the regression gate's noise-awareness runs the
 # bench/randomness.py runs test over it — and 512 points bound the
 # committed SERVE_BENCH file size
 EXACT_SAMPLES_CAP = 512
+# the synthetic generator's default pacing.  The original 500 QPS
+# assumed more headroom than the reference host sustains — the
+# recorded-mix baseline (SERVE_BENCH_r03.json) measured ~300 QPS
+# effective on this host, so pacing faster just manufactures queueing
+# the serving path never caused.  ``--qps`` overrides; ``--from-
+# recorded`` paces at the recorded stream's inter-arrival estimate.
+DEFAULT_QPS = 300.0
 
 # per-workload shape knob: (field, near value, cold values) — "exact"
 # queries use the warmed default shape; "near" sits in its power-of-two
@@ -306,7 +313,15 @@ def _replay_segmented(seg_path: str, queue_dir: str,
                           tenant="replay-seg", log=log)
     for kw in {json.dumps(t["request"], sort_keys=True) for t in trace}:
         svc.query(DriverRequest(**json.loads(kw)))  # warmup
-    fallback0 = get_metrics().counter("serve.verify_fallback").value
+    reg = get_metrics()
+    fallback0 = reg.counter("serve.verify_fallback").value
+    # fast-path economics (docs/serving.md "Fast path"): deltas over
+    # the replay window for the memo and fingerprint caches — the CI
+    # gate asserts the memo actually served (hits > 0)
+    fast0 = {name: reg.counter(name).value for name in (
+        "serve.memo.hits", "serve.memo.misses",
+        "serve.memo.invalidations",
+        "serve.fp_cache.hits", "serve.fp_cache.misses")}
     loop = ServeLoop(svc, ListenOpts(
         max_pending=max_pending, workers=workers,
         request_timeout_secs=request_timeout,
@@ -357,13 +372,30 @@ def _replay_segmented(seg_path: str, queue_dir: str,
                 cache_hits += 1
     out_reqlog = (loop.summary().get("reqlog")
                   if record_dir is not None else None)
+    fast = {name: reg.counter(name).value - v0
+            for name, v0 in fast0.items()}
+    memo_served = fast["serve.memo.hits"] + fast["serve.memo.misses"]
+    fp_probed = fast["serve.fp_cache.hits"] + fast["serve.fp_cache.misses"]
     return {
         "mode": "segmented",
         **({"reqlog": out_reqlog} if out_reqlog else {}),
         "resolve_us": _series(lat),
         "phases_us": _phase_series(phases),
         "exact_samples_us": exact_samples,
-        "verifier_calls": get_metrics().counter(
+        "memo": {
+            "hits": fast["serve.memo.hits"],
+            "misses": fast["serve.memo.misses"],
+            "invalidations": fast["serve.memo.invalidations"],
+            "hit_rate": (round(fast["serve.memo.hits"] / memo_served, 4)
+                         if memo_served else None),
+        },
+        "fp_cache": {
+            "hits": fast["serve.fp_cache.hits"],
+            "misses": fast["serve.fp_cache.misses"],
+            "hit_rate": (round(fast["serve.fp_cache.hits"] / fp_probed, 4)
+                         if fp_probed else None),
+        },
+        "verifier_calls": reg.counter(
             "serve.verify_fallback").value - fallback0,
         "shed": shed,
         "timeouts": timeouts,
@@ -376,7 +408,7 @@ def _replay_segmented(seg_path: str, queue_dir: str,
 
 
 def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
-               qps: float = 500.0, seed: int = 7,
+               qps: float = DEFAULT_QPS, seed: int = 7,
                mix: Optional[Dict[str, float]] = None, topk: int = 3,
                workdir: Optional[str] = None, keep_workdir: bool = False,
                max_pending: int = 256, workers: int = 2,
@@ -384,11 +416,16 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
                record_dir: Optional[str] = None,
                trace: Optional[List[Dict[str, Any]]] = None,
                recorded: Optional[Dict[str, Any]] = None,
+               pacing: Optional[Dict[str, Any]] = None,
+               fleet_scaling: Optional[Dict[str, Any]] = None,
                log=None) -> Dict[str, Any]:
     """The whole benchmark; returns the result document (see module
     docstring).  ``trace`` (with its ``recorded`` provenance block, from
     :func:`trace_from_recorded`) replaces the synthetic generator;
-    ``record_dir`` records the segmented path's traffic."""
+    ``record_dir`` records the segmented path's traffic;
+    ``fleet_scaling`` embeds a drain-fleet scaling measurement
+    (serve/fleet.py) so one SERVE_BENCH document carries both halves of
+    the serving story (resolution latency + drain throughput)."""
     mix = mix or {"exact": 0.8, "near": 0.15, "cold": 0.05}
     workloads = sorted(csv_globs)
     own_workdir = workdir is None
@@ -417,10 +454,21 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
             "version": REPLAY_VERSION,
             "n": n, "qps": qps, "seed": seed, "mix": mix,
             "workloads": workloads,
+            # pacing provenance: where the paced rate came from and
+            # what this host is known to sustain (the r03 measurement
+            # the default is clamped to) — a committed SERVE_BENCH says
+            # not just how fast it went but why it was paced that way
+            "pacing": dict({"qps": qps, "default_qps": DEFAULT_QPS,
+                            "sustained_note":
+                                "~300 QPS measured effective on the "
+                                "SERVE_BENCH_r03 host; the synthetic "
+                                "default is clamped to it"},
+                           **(pacing or {})),
             "warm": stores["warm"],
             **({"recorded": recorded} if recorded else {}),
             "monolithic": legacy,
             "segmented": seg,
+            **({"fleet_scaling": fleet_scaling} if fleet_scaling else {}),
             "exact_pct99_speedup": speedup,
         }
     finally:
@@ -445,8 +493,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="queries in the trace")
     ap.add_argument("--qps", type=float, default=None,
                     help="paced submission rate for the segmented path "
-                         "(default 500, or the recorded stream's "
+                         f"(default {DEFAULT_QPS:.0f} — the rate the "
+                         "r03 host sustains — or the recorded stream's "
                          "inter-arrival estimate under --from-recorded)")
+    ap.add_argument("--fleet-json", default=None, metavar="PATH",
+                    help="embed a drain-fleet scaling document "
+                         "(python -m tenzing_tpu.serve.fleet --out) as "
+                         "the result's fleet_scaling section")
     ap.add_argument("--record", default=None, metavar="DIR",
                     help="record the segmented path's replayed traffic "
                          "into this request-log directory "
@@ -488,7 +541,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         sys.stderr.write(m + "\n")
 
     trace = recorded = None
-    qps = args.qps if args.qps is not None else 500.0
+    qps = args.qps if args.qps is not None else DEFAULT_QPS
+    pacing_source = "override" if args.qps is not None else "default"
     if args.from_recorded:
         try:
             trace, recorded = trace_from_recorded(args.from_recorded,
@@ -500,16 +554,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.qps is None and est is not None and est > 0:
             # pace like the recorded stream unless the operator says so
             qps = est
+            pacing_source = "recorded-estimate"
         sys.stderr.write(
             f"replay: recorded trace {recorded['records']} request(s), "
             f"mix {recorded['mix']}, qps~{recorded['qps_estimate']}\n")
+    fleet_scaling = None
+    if args.fleet_json:
+        try:
+            with open(args.fleet_json) as f:
+                fleet_scaling = json.load(f)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"replay: unreadable --fleet-json "
+                             f"{args.fleet_json} ({e})\n")
+            return 2
     doc = run_replay(csv_globs, n=args.n, qps=qps, seed=args.seed,
                      mix=mix, topk=args.topk, workdir=args.workdir,
                      keep_workdir=args.workdir is not None,
                      max_pending=args.max_pending, workers=args.workers,
                      request_timeout=args.request_timeout,
                      record_dir=args.record, trace=trace,
-                     recorded=recorded, log=log)
+                     recorded=recorded,
+                     pacing={"source": pacing_source},
+                     fleet_scaling=fleet_scaling, log=log)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
@@ -520,6 +586,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "monolithic_exact": doc["monolithic"]["resolve_us"].get("exact"),
         "segmented_exact": doc["segmented"]["resolve_us"].get("exact"),
         "segmented_verifier_calls": doc["segmented"]["verifier_calls"],
+        "memo_hit_rate": doc["segmented"]["memo"]["hit_rate"],
+        "fp_cache_hit_rate": doc["segmented"]["fp_cache"]["hit_rate"],
         "shed": doc["segmented"]["shed"],
         "timeouts": doc["segmented"]["timeouts"],
         **({"recorded_mix": doc["recorded"]["mix"]}

@@ -33,6 +33,36 @@ breakdown** (``Resolution.phase_us``: fingerprint canonicalization,
 exact-cache probe, store walk) — the profile the ROADMAP's
 tens-of-µs exact-tier item steers by (docs/observability.md).
 
+**The fast path** (docs/serving.md "Fast path"): the measured phase
+profile says an exact hit spends its time on pure overhead —
+serialization, fingerprint canonicalization, digest hashing — so all
+three are compiled away:
+
+* **Sealed-response memoization** — when a record enters the exact
+  cache, the serialized response body is precomputed once per
+  (record, fingerprint) with placeholder slots for the per-request
+  fields; serving a hit is then a dict copy + two slot patches
+  (``phase_us``, ``trace_id``), byte-identical to fresh serialization
+  by construction (both go through the same ``Resolution.to_json``).
+  Invalidated with the store-generation bump (which every record
+  landing and every flag mutation performs) and on cache eviction —
+  ``serve.memo.{hits,misses,invalidations}`` count the economics.
+* **Fingerprint canonicalization cache** — resolutions arriving with a
+  verbatim request-kwargs tuple (:func:`fp_cache_key`) probe a bounded
+  cache of already-canonicalized fingerprints (digests precomputed),
+  collapsing shape resolution + canonical JSON + sha1 to a dict probe
+  (``serve.fp_cache.{hits,misses}``).  The recorded-traffic mix is
+  dominated by repeated shape buckets, so the hit rate is the serve
+  rate.
+* **Lock-free concurrent reads** — :meth:`Resolver.resolve_fast`
+  resolves exact hits against an immutable snapshot of the exact cache
+  (an atomically-replaced ``(generation, dict)`` pair) without any
+  lock: the listen loop's workers serve exact hits concurrently, and
+  only store writes / cold enqueues / the near tier still serialize
+  under the exclusive lock (serve/listen.py).  A snapshot whose
+  generation lags the store falls through to the exclusive path, so a
+  flag mutation or merge can never serve a stale answer.
+
 Resolution runs under a cross-process trace context (obs/context.py):
 the caller's (serve/listen.py mints one per request at ingress), or one
 minted here for context-less callers (the one-shot ``serve query``
@@ -55,6 +85,43 @@ from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.serve.fingerprint import WorkloadFingerprint, fingerprint_of
 from tenzing_tpu.serve.store import Record, ScheduleStore, WorkQueue
 
+# sealed-response slot sentinels: a memoized response carries these at
+# the per-request fields' natural positions, so patching them in place
+# preserves key order and the patched document is byte-identical to a
+# fresh serialization of the same resolution (the correctness contract
+# tests/test_serve_fastpath.py pins literally)
+_PHASE_SLOT: Dict[str, float] = {"_slot": 0.0}
+_TRACE_SLOT = "_slot"
+
+
+# an fp-cache key retains the VERBATIM client kwargs for the cache's
+# lifetime: entry-count bounds alone would let 4096 multi-megabyte
+# string values (valid DriverRequest path fields) pin gigabytes in a
+# long-lived serve loop, so oversized keys are simply uncacheable
+_FP_KEY_MAX_CHARS = 2048
+
+
+def fp_cache_key(kwargs: Any) -> Optional[Tuple]:
+    """The fingerprint-cache key: the **verbatim request kwargs** as a
+    sorted hashable tuple — no canonicalization, no shape resolution
+    (that is exactly the work the cache exists to skip).  ``None`` when
+    the kwargs are not a dict, carry an unhashable value, or are
+    oversized (module comment above) — such a request simply resolves
+    through the uncached path."""
+    if not isinstance(kwargs, dict):
+        return None
+    try:
+        key = tuple(sorted(kwargs.items()))
+        hash(key)
+    except TypeError:
+        return None
+    size = 0
+    for k, v in key:
+        size += len(k) + (len(v) if isinstance(v, str) else 8)
+        if size > _FP_KEY_MAX_CHARS:
+            return None
+    return key
+
 
 @dataclass
 class Resolution:
@@ -76,9 +143,29 @@ class Resolution:
     # profile serve/replay.py aggregates into SERVE_BENCH documents
     phase_us: Dict[str, float] = field(default_factory=dict)
     trace_id: Optional[str] = None
+    # the sealed response body (docs/serving.md "Fast path"): the
+    # to_json document precomputed when the record entered the exact
+    # cache, with slot sentinels where the per-request fields go —
+    # serving is then a dict copy + slot patches instead of fingerprint
+    # re-serialization and digest hashing
+    memo: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {
+        if self.memo is not None:
+            # copy-and-patch: assigning to a present key keeps its
+            # position, so the patched document's key order (and hence
+            # its json.dumps bytes) matches a fresh serialization
+            out = dict(self.memo)
+            if self.phase_us:
+                out["phase_us"] = self.phase_us
+            else:
+                out.pop("phase_us", None)
+            if self.trace_id is not None:
+                out["trace_id"] = self.trace_id
+            else:
+                out.pop("trace_id", None)
+            return out
+        out = {
             "tier": self.tier,
             "fingerprint": self.fingerprint.to_json(),
             "provenance": self.provenance,
@@ -143,12 +230,24 @@ class Resolver:
         self.exact_cache_cap = 4096
         self._graphs: Dict[str, Tuple[Any, Dict[str, int]]] = {}
         self._verifiers: Dict[str, Any] = {}
-        # exact digest -> (record, sequence, provenance) of the admitted
-        # best answer; validity keyed on the store's generation counter
-        # (any record landing anywhere invalidates wholesale — coarse,
-        # but merges are rare and wrong answers are forever)
-        self._exact_cache: Dict[str, Tuple[Record, Any, Dict[str, Any]]] = {}
+        # exact digest -> (record, sequence, provenance, sealed response
+        # memo) of the admitted best answer; validity keyed on the
+        # store's generation counter (any record landing anywhere — and
+        # every flag mutation — invalidates wholesale: coarse, but
+        # merges are rare and wrong answers are forever)
+        self._exact_cache: Dict[
+            str, Tuple[Record, Any, Dict[str, Any], Dict[str, Any]]] = {}
         self._exact_cache_gen = -1
+        # the lock-free read path's view: an immutable (generation,
+        # dict) pair replaced wholesale on every cache mutation —
+        # readers grab the attribute once (atomic under the GIL) and
+        # probe a dict no writer will ever mutate in place
+        self._exact_snapshot: Tuple[int, Dict[str, Any]] = (-1, {})
+        # verbatim-kwargs tuple -> canonicalized fingerprint with both
+        # digests precomputed (docs/serving.md "Fast path"); bounded
+        # like the exact cache — the key space is client-controlled
+        self.fp_cache_cap = 4096
+        self._fp_cache: Dict[Any, WorkloadFingerprint] = {}
         # (model, surrogate) per exact digest: the surrogate's
         # canonical-key prediction cache must survive across queries of
         # a hot fingerprint (re-featurizing the same neighbors per
@@ -162,7 +261,8 @@ class Resolver:
             self._log(msg)
 
     def _cache_put(self, cache: Dict[str, Any], key: str, value,
-                   cap: Optional[int] = None) -> None:
+                   cap: Optional[int] = None,
+                   on_evict: Optional[Callable[[Any], None]] = None) -> None:
         if key in cache:
             # re-put of a present key must update in place: evicting an
             # oldest entry for it would shrink the cache by one per
@@ -171,8 +271,150 @@ class Resolver:
             return
         cap = self.cache_cap if cap is None else cap
         while len(cache) >= cap:
-            cache.pop(next(iter(cache)))  # oldest insertion
+            evicted = cache.pop(next(iter(cache)))  # oldest insertion
+            if on_evict is not None:
+                on_evict(evicted)
         cache[key] = value
+
+    # -- fast path (docs/serving.md "Fast path") -----------------------------
+    def _publish_snapshot(self) -> None:
+        """Replace the lock-free readers' view after any exact-cache
+        mutation.  The copy is bounded by ``exact_cache_cap`` and only
+        paid on the mutation path (miss/invalidation) — never per hit."""
+        self._exact_snapshot = (self._exact_cache_gen,
+                                dict(self._exact_cache))
+
+    def _seal_response(self, fp: WorkloadFingerprint, rec: Record, seq,
+                       prov: Dict[str, Any]) -> Dict[str, Any]:
+        """The memoized response body for a cache hit of this record:
+        the full ``to_json`` document — fingerprint serialization and
+        digest hashing paid HERE, once — with slot sentinels at the
+        per-request fields' positions (patched per request by
+        :meth:`Resolution.to_json`)."""
+        sealed = Resolution(
+            tier="exact", fingerprint=fp, record=rec, sequence=seq,
+            pct50_us=rec.get("pct50_us"), vs_naive=rec.get("vs_naive"),
+            provenance=dict(prov, cache_hit=True))
+        # per-seal copy: aliasing the module-level sentinel into every
+        # memo would make one in-place mutation corrupt all of them
+        sealed.phase_us = dict(_PHASE_SLOT)
+        sealed.trace_id = _TRACE_SLOT
+        return sealed.to_json()
+
+    def _cache_exact(self, fp: WorkloadFingerprint, rec: Record, seq,
+                     prov: Dict[str, Any]) -> None:
+        """Admit one record into the exact cache: seal its response
+        memo, evict (counting the dropped memo as an invalidation), and
+        publish a fresh snapshot for the lock-free readers."""
+        memo = self._seal_response(fp, rec, seq, prov)
+        self._cache_put(
+            self._exact_cache, fp.exact_digest, (rec, seq, prov, memo),
+            cap=self.exact_cache_cap,
+            on_evict=lambda _: get_metrics().counter(
+                "serve.memo.invalidations").inc())
+        self._publish_snapshot()
+
+    def _drop_exact(self, exact: str) -> None:
+        """Invalidate one cached answer (e.g. a record flagged unsound
+        by a caller holding the same dict) — counted, and republished so
+        the lock-free readers stop seeing it immediately."""
+        if self._exact_cache.pop(exact, None) is not None:
+            get_metrics().counter("serve.memo.invalidations").inc()
+            self._publish_snapshot()
+
+    def _invalidate_exact_cache(self, gen: int) -> None:
+        """The store-generation bump: every record landing and every
+        flag mutation moves the generation, and the whole answer cache
+        (records, sequences, sealed memos) dies with it."""
+        if self._exact_cache:
+            get_metrics().counter("serve.memo.invalidations").inc(
+                len(self._exact_cache))
+            self._exact_cache.clear()
+        self._exact_cache_gen = gen
+        self._publish_snapshot()
+
+    def _fingerprint(self, req, fp_key: Optional[Tuple]):
+        """:func:`fingerprint_of` through the canonicalization cache:
+        a request arriving with a verbatim-kwargs key
+        (:func:`fp_cache_key`) probes the bounded cache first; a miss
+        canonicalizes once, precomputes both digests, and caches — the
+        recorded-traffic mix repeats shape buckets, so the steady state
+        is one dict probe."""
+        if fp_key is not None:
+            fp = self._fp_cache.get(fp_key)
+            if fp is not None:
+                get_metrics().counter("serve.fp_cache.hits").inc()
+                return fp
+        fp = fingerprint_of(req)
+        if fp_key is not None:
+            _ = (fp.exact_digest, fp.bucket_digest)  # warm both digests
+            self._cache_put(self._fp_cache, fp_key, fp,
+                            cap=self.fp_cache_cap)
+            get_metrics().counter("serve.fp_cache.misses").inc()
+        return fp
+
+    def resolve_fast(self, fp_key: Optional[Tuple]) -> Optional[Resolution]:
+        """The lock-free exact tier: fingerprint-cache probe + snapshot
+        probe + memoized response, **no lock, no store access beyond one
+        generation read** — safe to call from any number of threads
+        concurrently (serve/listen.py's workers do).  ``None`` means
+        "not servable lock-free" (cold fingerprint cache, stale
+        snapshot, non-exact tier): the caller falls through to
+        :meth:`resolve` under its exclusive lock, which repopulates
+        every cache this path reads."""
+        if fp_key is None:
+            return None
+        t0 = time.perf_counter()
+        fp = self._fp_cache.get(fp_key)
+        if fp is None:
+            return None
+        reg = get_metrics()
+        phases: Dict[str, float] = {}
+        phases["fingerprint"] = round((time.perf_counter() - t0) * 1e6, 2)
+        t_probe = time.perf_counter()
+        gen_snap, snap = self._exact_snapshot
+        if gen_snap != getattr(self.store, "generation", 0):
+            return None  # the exclusive path refreshes the snapshot
+        hit = snap.get(fp.exact_digest)
+        if hit is None:
+            return None
+        rec, seq, prov, memo = hit
+        if rec.get("flags", {}).get("unsound"):
+            # flagged by a caller holding the same record dict (a
+            # store.flag goes through the generation bump and never
+            # reaches here): let the exclusive path drop + re-walk
+            return None
+        phases["cache_probe"] = round(
+            (time.perf_counter() - t_probe) * 1e6, 2)
+        ctx = obs_context.current() or obs_context.new_trace()
+        reg.counter("serve.fp_cache.hits").inc()
+        reg.counter("serve.exact_cache.hits").inc()
+        reg.counter("serve.memo.hits").inc()
+        reg.counter("serve.exact").inc()
+        res = Resolution(
+            tier="exact", fingerprint=fp, record=rec, sequence=seq,
+            pct50_us=rec.get("pct50_us"), vs_naive=rec.get("vs_naive"),
+            provenance=dict(prov, cache_hit=True), memo=memo)
+        res.phase_us = phases
+        res.trace_id = ctx.trace_id
+        dt_us = (time.perf_counter() - t0) * 1e6
+        reg.histogram("serve.resolve_us", window=True).observe(dt_us)
+        reg.histogram("serve.resolve_us.exact", window=True).observe(dt_us)
+        tr = get_tracer()
+        if tr.enabled:
+            # emitted AFTER the fact so a fall-through never produces a
+            # duplicate serve.query span next to the exclusive path's:
+            # the span's own duration is therefore ~0 — the real
+            # latency rides the resolve_us attribute (and phase_us on
+            # the response), which is what timing analyses must read
+            # for fast-path traffic
+            with obs_context.use(ctx), tr.span("serve.query") as sp:
+                sp.set("workload", fp.workload)
+                sp.set("exact", fp.exact_digest)
+                sp.set("tier", "exact")
+                sp.set("fast_path", True)
+                sp.set("resolve_us", round(dt_us, 2))
+        return res
 
     def _graph(self, req, fp: WorkloadFingerprint):
         got = self._graphs.get(fp.exact_digest)
@@ -221,23 +463,26 @@ class Resolver:
                     # record flagged between the generation bump and this
                     # probe (or by a caller holding the same dict) must
                     # never be served
-                    self._exact_cache.pop(fp.exact_digest, None)
+                    self._drop_exact(fp.exact_digest)
                     hit = None
                 if hit is not None:
                     # the hot path: one dict probe, zero
                     # materializations, zero verifier invocations — the
                     # record was admitted (verified + sealed) when it
-                    # entered the cache
-                    rec, seq, prov = hit
+                    # entered the cache, and its response body was
+                    # sealed with it (the memo the transport patches)
+                    rec, seq, prov, memo = hit
                     phases["cache_probe"] = round(
                         (time.perf_counter() - t0) * 1e6, 2)
                     psp.set("hit", True)
                     reg.counter("serve.exact_cache.hits").inc()
+                    reg.counter("serve.memo.hits").inc()
                     return Resolution(
                         tier="exact", fingerprint=fp, record=rec,
                         sequence=seq, pct50_us=rec.get("pct50_us"),
                         vs_naive=rec.get("vs_naive"),
-                        provenance=dict(prov, cache_hit=True))
+                        provenance=dict(prov, cache_hit=True),
+                        memo=memo)
             psp.set("hit", False)
         phases["cache_probe"] = round((time.perf_counter() - t0) * 1e6, 2)
         t_walk = time.perf_counter()
@@ -319,9 +564,11 @@ class Resolver:
                 **rec.get("provenance", {}),
             }
             if self.serve_cache and verified is not False:
-                self._cache_put(self._exact_cache, fp.exact_digest,
-                                (rec, seq, prov),
-                                cap=self.exact_cache_cap)
+                # entering the cache seals the response memo: this
+                # fresh serve paid full serialization (counted as the
+                # memo miss), every cache hit after it is copy-and-patch
+                reg.counter("serve.memo.misses").inc()
+                self._cache_exact(fp, rec, seq, prov)
             return Resolution(tier="exact", fingerprint=fp, record=rec,
                               sequence=seq, pct50_us=rec.get("pct50_us"),
                               vs_naive=rec.get("vs_naive"),
@@ -423,26 +670,30 @@ class Resolver:
         return fn() if callable(fn) else dict(vars(req))
 
     # -- entry ---------------------------------------------------------------
-    def resolve(self, req) -> Resolution:
+    def resolve(self, req, fp_key: Optional[Tuple] = None) -> Resolution:
         """Resolve a :class:`~tenzing_tpu.bench.driver.DriverRequest`
         through the tiers, under the ambient trace context (one is
         minted here when the caller arrived without one — the resolver
-        is the ingress of record for non-listen paths)."""
+        is the ingress of record for non-listen paths).  ``fp_key`` is
+        the request's verbatim-kwargs tuple (:func:`fp_cache_key`) when
+        the caller has one: it keys the fingerprint canonicalization
+        cache and seeds :meth:`resolve_fast` for the next arrival."""
         ctx = obs_context.current() or obs_context.new_trace()
         with obs_context.use(ctx):
-            return self._resolve(req, ctx)
+            return self._resolve(req, ctx, fp_key)
 
-    def _resolve(self, req, ctx) -> Resolution:
+    def _resolve(self, req, ctx, fp_key: Optional[Tuple] = None) -> Resolution:
         reg = get_metrics()
         tr = get_tracer()
         t0 = time.perf_counter()
         gen = getattr(self.store, "generation", 0)
         if gen != self._exact_cache_gen:
-            # any record landing anywhere (add/merge/load) invalidates
-            # the whole answer cache: coarse, but merges are rare and a
-            # stale answer would outlive the better record that beat it
-            self._exact_cache.clear()
-            self._exact_cache_gen = gen
+            # any record landing anywhere (add/merge/load/flag)
+            # invalidates the whole answer cache: coarse, but merges are
+            # rare and a stale answer would outlive the better record
+            # that beat it — counted per sealed memo dropped, and the
+            # lock-free snapshot is republished empty
+            self._invalidate_exact_cache(gen)
         phases: Dict[str, float] = {}
         with tr.span("serve.query") as sp:
             # fingerprint canonicalization is the first per-hit phase the
@@ -451,9 +702,9 @@ class Resolver:
             t_fp = time.perf_counter()
             if tr.enabled:
                 with tr.span("serve.fingerprint"):
-                    fp = fingerprint_of(req)
+                    fp = self._fingerprint(req, fp_key)
             else:
-                fp = fingerprint_of(req)
+                fp = self._fingerprint(req, fp_key)
             phases["fingerprint"] = round(
                 (time.perf_counter() - t_fp) * 1e6, 2)
             sp.set("workload", fp.workload)

@@ -224,8 +224,13 @@ class ScheduleService:
                 "train_spearman": info["train_spearman"]}
 
     # -- query / merge / stats ----------------------------------------------
-    def query(self, req: DriverRequest) -> Resolution:
-        return self.resolver.resolve(req)
+    def query(self, req: DriverRequest,
+              fp_key: Optional[tuple] = None) -> Resolution:
+        """Tiered resolution.  ``fp_key`` (the verbatim request-kwargs
+        tuple, :func:`~tenzing_tpu.serve.resolver.fp_cache_key`) seeds
+        the fingerprint cache and the lock-free fast path for callers
+        that have the raw kwargs (the listen loop)."""
+        return self.resolver.resolve(req, fp_key=fp_key)
 
     def merge(self, other_path: str) -> Dict[str, Any]:
         other = ScheduleStore(other_path, log=self._note)

@@ -459,3 +459,18 @@ def test_latest_snapshots_survives_seq_reset_across_restart(tmp_path,
     latest = latest_snapshots(str(tmp_path))
     assert latest["own"]["seq"] == 0
     assert latest["own"]["state"] == "idle"
+
+
+def test_mint_buffer_unique_and_fork_reset():
+    """The buffered urandom pool (ISSUE 14: one syscall per 4 KiB, not
+    per id): ids stay 16-hex and unique across refills, and the buffer
+    resets empty on the fork hook so a child can never replay the
+    parent's entropy window."""
+    from tenzing_tpu.obs import context as obs_context
+
+    ids = {obs_context._mint_id() for _ in range(2000)}  # spans refills
+    assert len(ids) == 2000
+    assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+    obs_context._mint_reset()
+    assert obs_context._mint_buf == b"" and obs_context._mint_pos == 0
+    assert len(obs_context._mint_id()) == 16  # refills transparently

@@ -1,0 +1,211 @@
+"""Drain-fleet acceptance (ISSUE 14): N daemons work-stealing one
+queue — zero double-runs by the lease protocol, drain-rate scaling
+measured, the audit mined from the fleet's own status documents.
+
+The scaling test drives real :class:`DrainDaemon` instances through
+the shipped :func:`stub_spawner` (fixed-cost 0.5s drains — the
+device-wait-dominated regime, deterministic, no device), through the
+same :func:`run_fleet` / :func:`measure_scaling` entry points the CLI
+uses.  The subprocess launcher's argv is golden-checked so the CLI and
+the harness cannot drift.
+"""
+
+import json
+import os
+
+from tenzing_tpu.bench.driver import DriverRequest
+from tenzing_tpu.serve.fingerprint import fingerprint_of
+from tenzing_tpu.serve.fleet import (
+    FleetOpts,
+    _daemon_cmd,
+    audit_completions,
+    copy_queue_items,
+    measure_scaling,
+    run_fleet,
+    stub_spawner,
+)
+from tenzing_tpu.serve.store import WorkQueue
+
+
+def _enqueue_n(qdir, n=4):
+    """n distinct spmv work items (distinct m -> distinct digests)."""
+    q = WorkQueue(qdir)
+    fps = []
+    for i in range(n):
+        req = DriverRequest(workload="spmv", m=512 + 200 * i)
+        fp = fingerprint_of(req)
+        q.enqueue(fp, req.to_json(), reason="cold")
+        fps.append(fp)
+    return q, fps
+
+
+def test_fleet_scaling_two_daemons_four_items(tmp_path):
+    """THE fleet acceptance: 2 daemons drain a 4-item queue with zero
+    double-runs and a measured drain rate >= 1.5x the single-daemon
+    rate on the same queue (the items are identical per rung)."""
+    src = str(tmp_path / "src-q")
+    _enqueue_n(src, n=4)
+    # heartbeat 0.5: every heartbeat is a status + snapshot fsync pair
+    # per member, and fsync jitter on a noisy host is the main
+    # wall-clock noise this measurement fights
+    opts = FleetOpts(queue_dir=src, store_path="",  # per-rung stores
+                     idle_exit_secs=0.25, poll_secs=0.05,
+                     heartbeat_secs=0.5, owner_prefix="t")
+    # 1.0s fixed-cost drains: the scaling signal (seconds) must dwarf
+    # host jitter; wall-clock outcomes retry up to 4 times (a stalled
+    # rung on an oversubscribed CI host is not the protocol property
+    # under test) — correctness assertions (exactly-once, full drain)
+    # hold on EVERY attempt, never retried past
+    for attempt in range(4):
+        doc = measure_scaling(opts, [1, 2],
+                              str(tmp_path / f"scale{attempt}"),
+                              log=lambda m: None,
+                              spawn=stub_spawner(1.0),
+                              drain_label="stub:1.0s")
+        assert doc["kind"] == "drain_fleet_scaling"
+        assert doc["drain"] == "stub:1.0s"
+        assert doc["double_runs_total"] == 0
+        by_n = {r["n_daemons"]: r for r in doc["rungs"]}
+        for n in (1, 2):
+            r = by_n[n]
+            assert r["drained"] == 4, r
+            assert r["queue_after"] == 0, r
+            assert r["double_runs"] == {}, r
+            assert r["audit_complete"] is True
+        # scheduling-dependent outcomes (participation, wall-clock
+        # speedup) are retry-guarded together: a noisy host can stall
+        # one member's thread start or a rung's wall clock, and neither
+        # is the protocol property under test
+        two = by_n[2]
+        owners = {o for owners_ in two["completed_by"].values()
+                  for o in owners_}
+        if len(owners) == 2 and two["speedup_vs_n1"] >= 1.5:
+            break
+    assert len(owners) == 2, two["completed_by"]
+    assert two["speedup_vs_n1"] >= 1.5, doc
+
+
+def test_fleet_single_run_audit_and_rates(tmp_path):
+    qdir = str(tmp_path / "q")
+    _enqueue_n(qdir, n=3)
+    opts = FleetOpts(queue_dir=qdir,
+                     store_path=str(tmp_path / "store.json"),
+                     n=2, idle_exit_secs=0.25, poll_secs=0.05,
+                     heartbeat_secs=0.1, owner_prefix="s")
+    doc = run_fleet(opts, spawn=stub_spawner(0.2), log=lambda m: None)
+    assert doc["items_before"] == 3 and doc["drained"] == 3
+    assert doc["queue_after"] == 0
+    assert doc["double_runs"] == {}
+    assert doc["drain_rate_per_s"] > 0
+    assert len(doc["daemons"]) == 2
+    assert all(d["rc"] == 0 for d in doc["daemons"])
+    # every completion attributed to exactly one owner
+    assert sorted(doc["completed_by"]) == sorted(
+        fp.exact_digest for fp in _enqueue_n(str(tmp_path / "ref"), 3)[1])
+    assert all(len(v) == 1 for v in doc["completed_by"].values())
+
+
+def test_audit_flags_double_runs(tmp_path):
+    """A fabricated pair of status docs claiming the same exact digest
+    completed twice must surface in double_runs — the audit is the
+    exactly-once proof, so it must actually be able to fail."""
+    qdir = str(tmp_path / "q")
+    os.makedirs(qdir)
+    for owner in ("f-0", "f-1"):
+        with open(os.path.join(qdir, f"status-{owner}.json"), "w") as f:
+            json.dump({"counters": {"completed": 1},
+                       "history": [{"exact": "deadbeef",
+                                    "outcome": "completed"}]}, f)
+    audit = audit_completions(qdir, ["f-0", "f-1"])
+    assert audit["double_runs"] == {"deadbeef": ["f-0", "f-1"]}
+    assert audit["audit_complete"] is True
+    # a missing status doc demotes the audit to incomplete, not a crash
+    audit2 = audit_completions(qdir, ["f-0", "f-1", "f-2"])
+    assert audit2["audit_complete"] is False
+
+
+def test_copy_queue_items_copies_only_items(tmp_path):
+    src = str(tmp_path / "src")
+    _enqueue_n(src, n=2)
+    # protocol litter that must NOT ride along into a fresh rung
+    for name in ("lease-aaa.json", "fail-bbb.json", "poison-ccc.json",
+                 "status-x.json"):
+        with open(os.path.join(src, name), "w") as f:
+            f.write("{}")
+    os.makedirs(os.path.join(src, "ckpt-ddd"))
+    dst = str(tmp_path / "dst")
+    assert copy_queue_items(src, dst) == 2
+    names = sorted(os.listdir(dst))
+    assert len(names) == 2 and all(n.startswith("work-") for n in names)
+    # the copies are valid, drainable items
+    assert len(WorkQueue(dst)) == 2
+
+
+def test_daemon_cmd_golden(tmp_path):
+    """The member argv: one place (fleet.py _daemon_cmd), golden-checked
+    so the subprocess launcher and a hand-reproduced member agree."""
+    opts = FleetOpts(queue_dir="Q", store_path="S", n=2,
+                     overrides={"mcts_iters": 6},
+                     trace_dir=str(tmp_path / "tr"),
+                     idle_exit_secs=3.0)
+    cmd = _daemon_cmd(opts, 1)
+    joined = " ".join(cmd)
+    assert "-m tenzing_tpu.serve.daemon" in joined
+    assert "--queue Q" in joined and "--store S" in joined
+    assert "--owner fleet-1" in joined
+    assert "--idle-exit 3.0" in joined
+    assert "--override mcts_iters=6" in joined
+    assert f"--trace-out {tmp_path / 'tr'}/daemon-1.jsonl" in joined
+
+
+def test_fleet_items_keep_trace_ids(tmp_path):
+    """Items enqueued under a trace context carry it into the fleet
+    doc's stitched-per-item accounting (the envelope is what links a
+    drain back to the query that caused it)."""
+    from tenzing_tpu.obs import context as obs_context
+    from tenzing_tpu.serve.fleet import _item_traces
+
+    qdir = str(tmp_path / "q")
+    q = WorkQueue(qdir)
+    req = DriverRequest(workload="spmv", m=512)
+    ctx = obs_context.new_trace()
+    q.enqueue(fingerprint_of(req), req.to_json(), reason="cold",
+              trace=ctx)
+    traces = _item_traces(q)
+    fp = fingerprint_of(req)
+    assert traces == {fp.exact_digest: ctx.trace_id}
+
+
+def test_fleet_exit_code_policy():
+    """Nonzero on a double run OR a dead member; undrained items are
+    data, not failure (a transient-failing item legitimately waits)."""
+    from tenzing_tpu.serve.fleet import fleet_exit_code
+
+    ok = {"kind": "drain_fleet", "double_runs": {}, "queue_after": 3,
+          "daemons": [{"rc": 0}, {"rc": 0}]}
+    assert fleet_exit_code(ok) == 0
+    assert fleet_exit_code({**ok, "double_runs": {"x": ["a", "b"]}}) == 1
+    assert fleet_exit_code(
+        {**ok, "daemons": [{"rc": 0}, {"rc": 1, "error": "boom"}]}) == 1
+    scale_ok = {"kind": "drain_fleet_scaling", "double_runs_total": 0,
+                "rungs": [{"daemons": [{"rc": 0}]},
+                          {"daemons": [{"rc": 0}, {"rc": 0}]}]}
+    assert fleet_exit_code(scale_ok) == 0
+    assert fleet_exit_code({**scale_ok, "double_runs_total": 1}) == 1
+    bad_rung = {**scale_ok,
+                "rungs": [{"daemons": [{"rc": -9}]},
+                          {"daemons": [{"rc": 0}, {"rc": 0}]}]}
+    assert fleet_exit_code(bad_rung) == 1
+
+
+def test_daemon_cmd_item_timeout_zero_passes_through(tmp_path):
+    """--item-timeout 0 means "watchdog disabled" to the daemon; the
+    member argv must pass the 0 through, not omit the flag (omission
+    silently reinstates the daemon's 3600s default)."""
+    opts = FleetOpts(queue_dir="Q", store_path="S",
+                     item_timeout_secs=0.0)
+    cmd = " ".join(_daemon_cmd(opts, 0))
+    assert "--item-timeout 0.0" in cmd
+    none_opts = FleetOpts(queue_dir="Q", store_path="S",
+                          item_timeout_secs=None)
+    assert "--item-timeout" not in " ".join(_daemon_cmd(none_opts, 0))

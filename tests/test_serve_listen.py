@@ -463,3 +463,225 @@ def test_cold_work_item_carries_ingress_trace(tmp_path, corpus):
     assert d["result"]["tier"] == "cold"
     item = read_checked_json(d["result"]["work_item"])
     assert item["trace"]["trace_id"] == d["trace_id"]
+
+
+# -- per-tenant fair admission (ISSUE 14 satellite) --------------------------
+
+def test_tenant_cap_sheds_over_cap_tenant_only(tmp_path):
+    """One tenant's burst hits its own in-flight cap (shed with reason
+    tenant_cap, counted per tenant) while another tenant and untagged
+    requests still admit — the burst can no longer starve the rest by
+    filling the global bound."""
+    from tenzing_tpu.obs.metrics import MetricsRegistry, set_metrics
+
+    prev = set_metrics(MetricsRegistry())
+    try:
+        svc = _StubService(delay=0.3)
+        loop = ServeLoop(svc, ListenOpts(
+            max_pending=16, workers=1, tenant_max_pending=2,
+            request_timeout_secs=30.0, handle_signals=False,
+            status_path=str(tmp_path / "status.json")))
+        loop.start()
+        docs, respond = _collect()
+        # tenant "hog" bursts 6 deep: 2 admit (its cap), 4 shed
+        for i in range(6):
+            loop.submit({"op": "query", "id": f"hog-{i}",
+                         "tenant": "hog",
+                         "request": {"workload": "spmv", "m": 512}},
+                        respond)
+        # a second tenant and an untagged request admit despite the burst
+        loop.submit({"op": "query", "id": "quiet", "tenant": "quiet",
+                     "request": {"workload": "spmv", "m": 512}}, respond)
+        loop.submit({"op": "query", "id": "untagged",
+                     "request": {"workload": "spmv", "m": 512}}, respond)
+        loop.drain(timeout=20.0)
+        by_id = {d.get("id"): d for d in docs}
+        shed = [d for d in docs if d.get("shed")]
+        assert all(d["reason"] == "tenant_cap" for d in shed), shed
+        assert len(shed) == 4
+        assert all(str(d["id"]).startswith("hog-") for d in shed)
+        assert by_id["quiet"]["ok"] is True
+        assert by_id["untagged"]["ok"] is True
+        # the shed burst is measured per tenant (the PR-13 counters)
+        from tenzing_tpu.obs.metrics import get_metrics
+
+        assert get_metrics().counter("serve.shed.hog").value == 4
+        assert get_metrics().counter("serve.shed.quiet").value == 0
+    finally:
+        set_metrics(prev)
+
+
+def test_tenant_cap_default_derivation_and_disable(tmp_path):
+    svc = _StubService()
+    loop = ServeLoop(svc, ListenOpts(max_pending=64, handle_signals=False,
+                                     status_path=str(tmp_path / "s1.json")))
+    assert loop._tenant_pending_cap() == 32  # default: max_pending // 2
+    loop2 = ServeLoop(svc, ListenOpts(
+        max_pending=64, tenant_max_pending=0, handle_signals=False,
+        status_path=str(tmp_path / "s2.json")))
+    assert loop2._tenant_pending_cap() == 0  # 0 disables
+
+    # disabled: a burst beyond any per-tenant bound reaches the global
+    # queue instead of tenant_cap shedding
+    svc3 = _StubService(delay=0.2)
+    loop3 = ServeLoop(svc3, ListenOpts(
+        max_pending=4, workers=1, tenant_max_pending=0,
+        request_timeout_secs=30.0, handle_signals=False,
+        status_path=str(tmp_path / "s3.json")))
+    loop3.start()
+    docs, respond = _collect()
+    for i in range(8):
+        loop3.submit({"op": "query", "id": i, "tenant": "hog",
+                      "request": {"workload": "spmv", "m": 512}}, respond)
+    loop3.drain(timeout=20.0)
+    shed = [d for d in docs if d.get("shed")]
+    assert shed and all(d["reason"] == "queue-full" for d in shed)
+
+
+def test_non_string_tenant_never_crashes_admission(tmp_path):
+    """Client input: an unhashable (or otherwise non-string) tenant
+    value must not crash submit() — pre-guard it DoS'd the whole stdin
+    loop with one request.  Such requests admit uncapped, like untagged
+    ones, and stay invisible to per-tenant telemetry."""
+    svc = _StubService()
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=8, workers=1, tenant_max_pending=1,
+        request_timeout_secs=30.0, handle_signals=False,
+        status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    for i, tenant in enumerate(({"x": 1}, [1, 2], 5, None)):
+        loop.submit({"op": "query", "id": i, "tenant": tenant,
+                     "request": {"workload": "spmv", "m": 512}}, respond)
+    loop.drain(timeout=10.0)
+    assert len(docs) == 4
+    assert all(d.get("ok") for d in docs), docs
+    assert loop._tenant_live == {}  # nothing leaked into the counts
+
+
+def test_tenant_cap_weighs_batch_members(tmp_path):
+    """A batch payload counts its member requests against the tenant
+    cap — one batch slot must not smuggle N sub-requests past the
+    fairness bound a single-query burst would have shed on."""
+    svc = _StubService(delay=0.3)
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=16, workers=1, tenant_max_pending=3,
+        request_timeout_secs=30.0, handle_signals=False,
+        status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    member = {"request": {"workload": "spmv", "m": 512}}
+    # 4 members > cap 3: shed outright, reason tenant_cap
+    loop.submit({"op": "batch", "id": "big", "tenant": "hog",
+                 "requests": [dict(member) for _ in range(4)]}, respond)
+    # 2 members fit; a following 2-member batch would exceed (2+2 > 3)
+    loop.submit({"op": "batch", "id": "ok", "tenant": "hog",
+                 "requests": [dict(member) for _ in range(2)]}, respond)
+    loop.submit({"op": "batch", "id": "over", "tenant": "hog",
+                 "requests": [dict(member) for _ in range(2)]}, respond)
+    loop.drain(timeout=20.0)
+    by_id = {d.get("id"): d for d in docs}
+    assert by_id["big"].get("shed") and \
+        by_id["big"]["reason"] == "tenant_cap", by_id["big"]
+    assert by_id["ok"]["ok"] is True and len(by_id["ok"]["results"]) == 2
+    assert by_id["over"].get("shed") and \
+        by_id["over"]["reason"] == "tenant_cap", by_id["over"]
+    assert loop._tenant_live == {}  # weights fully released on drain
+
+
+def test_tenant_cap_charges_batch_members_to_their_own_tenant(tmp_path):
+    """Member-level tenant tags cannot smuggle past the cap: a batch
+    with NO top-level tenant whose members all tag one tenant charges
+    that tenant — the same effective-tenant rule execution and
+    telemetry apply (r.get("tenant", payload_tenant))."""
+    svc = _StubService(delay=0.3)
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=16, workers=1, tenant_max_pending=3,
+        request_timeout_secs=30.0, handle_signals=False,
+        status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    member = {"tenant": "hog", "request": {"workload": "spmv", "m": 512}}
+    # untagged batch, 5 hog-tagged members > cap 3: shed whole
+    loop.submit({"op": "batch", "id": "smuggle",
+                 "requests": [dict(member) for _ in range(5)]}, respond)
+    # a mixed batch within every member tenant's cap admits
+    loop.submit({"op": "batch", "id": "mixed",
+                 "requests": [dict(member),
+                              {"tenant": "quiet",
+                               "request": {"workload": "spmv", "m": 512}}]},
+                respond)
+    loop.drain(timeout=20.0)
+    by_id = {d.get("id"): d for d in docs}
+    assert by_id["smuggle"].get("shed") and \
+        by_id["smuggle"]["reason"] == "tenant_cap", by_id["smuggle"]
+    assert by_id["mixed"]["ok"] is True, by_id["mixed"]
+    assert loop._tenant_live == {}
+
+
+def test_derived_tenant_cap_is_work_conserving(tmp_path):
+    """The DERIVED default cap only bites once a second distinct tenant
+    exists: a sole tagged tenant keeps the full global queue (fairness
+    against nobody is pure waste), and the newcomer's first submission
+    activates the cap for the hog's next burst."""
+    from tenzing_tpu.obs.metrics import MetricsRegistry, set_metrics
+
+    prev = set_metrics(MetricsRegistry())
+    try:
+        svc = _StubService(delay=0.25)
+        loop = ServeLoop(svc, ListenOpts(
+            max_pending=16, workers=1,  # derived cap would be 8
+            request_timeout_secs=30.0, handle_signals=False,
+            status_path=str(tmp_path / "status.json")))
+        loop.start()
+        docs, respond = _collect()
+        # sole tenant: a 10-deep burst (over the derived cap of 8) all
+        # admits — only the global bound applies
+        for i in range(10):
+            loop.submit({"op": "query", "id": f"a{i}", "tenant": "hog",
+                         "request": {"workload": "spmv", "m": 512}},
+                        respond)
+        assert not [d for d in docs if d.get("shed")]
+        # a second tenant appears: its submission registers it, and the
+        # hog's NEXT submissions hit the now-active derived cap
+        loop.submit({"op": "query", "id": "q", "tenant": "quiet",
+                     "request": {"workload": "spmv", "m": 512}}, respond)
+        for i in range(4):
+            loop.submit({"op": "query", "id": f"b{i}", "tenant": "hog",
+                         "request": {"workload": "spmv", "m": 512}},
+                        respond)
+        loop.drain(timeout=30.0)
+        shed = [d for d in docs if d.get("shed")]
+        assert shed, "derived cap never activated after second tenant"
+        assert all(d["reason"] == "tenant_cap" for d in shed)
+        assert all(str(d["id"]).startswith("b") for d in shed), shed
+    finally:
+        set_metrics(prev)
+
+
+def test_member_tenant_shed_charged_to_over_cap_tenant(tmp_path):
+    """A tenant_cap shed caused by a MEMBER tenant of an untagged batch
+    charges serve.shed.<that tenant> — the cap's own actions must be
+    visible in the fairness counters it claims as its measurement."""
+    from tenzing_tpu.obs.metrics import MetricsRegistry, set_metrics
+    from tenzing_tpu.obs.metrics import get_metrics as _gm
+
+    prev = set_metrics(MetricsRegistry())
+    try:
+        svc = _StubService(delay=0.3)
+        loop = ServeLoop(svc, ListenOpts(
+            max_pending=16, workers=1, tenant_max_pending=2,
+            request_timeout_secs=30.0, handle_signals=False,
+            status_path=str(tmp_path / "status.json")))
+        loop.start()
+        docs, respond = _collect()
+        member = {"tenant": "noisy",
+                  "request": {"workload": "spmv", "m": 512}}
+        loop.submit({"op": "batch", "id": "b",
+                     "requests": [dict(member) for _ in range(5)]},
+                    respond)
+        loop.drain(timeout=20.0)
+        assert docs[0].get("shed") and docs[0]["reason"] == "tenant_cap"
+        assert _gm().counter("serve.shed.noisy").value == 1
+    finally:
+        set_metrics(prev)
