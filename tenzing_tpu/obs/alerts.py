@@ -35,6 +35,18 @@ file or ``--set rule.param=value``):
 * ``tracer_drops`` — a snapshot whose tracer retention block shows
   dropped spans/events: telemetry is being lost, the one condition the
   telemetry itself must shout about.
+* ``tenant_shed`` — per-tenant fairness (the PR 13 counters,
+  ``serve.shed.<tenant>`` / ``serve.timeout.<tenant>``, capped tenant
+  set and all): sheds or timeouts attributed to one tenant grew across
+  the snapshot ring beyond ``max_shed``/``max_timeout`` — one noisy
+  neighbour is eating the fleet's admission budget.
+* ``queue_backlog_burn`` — arrival rate (reqlog position deltas across
+  the snapshot ring) against the drain fleet's measured rate (per-item
+  wall-clock economics from daemon status histories): when cold work
+  arrives faster than the fleet drains it, the queue grows without
+  bound and the alert says how many daemons would balance it — the
+  signal the fleet-autoscaling item will consume
+  (:func:`backlog_summary`, also rendered by ``report --follow``).
 
 **State machine** (:class:`AlertBook`): alerts key on
 ``rule:subject``.  A newly-seen alert transitions to ``firing`` (one
@@ -77,6 +89,16 @@ DEFAULT_RULES: Dict[str, Dict[str, Any]] = {
     "poison": {"enabled": True, "severity": "ticket"},
     "tracer_drops": {"enabled": True, "severity": "ticket",
                      "max_dropped": 0},
+    # 0 = any per-tenant shed/timeout growth across the ring fires; a
+    # busy fleet raises these to its tolerated per-tenant budget
+    "tenant_shed": {"enabled": True, "severity": "ticket",
+                    "max_shed": 0, "max_timeout": 0},
+    # arrival must exceed drain by this factor (and by a non-trivial
+    # absolute rate) with work actually queued before paging — a
+    # momentarily idle fleet with an empty queue is not a backlog
+    "queue_backlog_burn": {"enabled": True, "severity": "page",
+                           "burn_ratio": 1.2,
+                           "min_arrival_per_s": 0.1},
 }
 
 
@@ -264,6 +286,49 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
                     r["max_wait_s"],
                     f"oldest pending request waited {wait}s "
                     f"(> {r['max_wait_s']}s) in the bounded queue"))
+            r = on("tenant_shed")
+            if r:
+                # per-tenant fairness over the snapshot ring: growth of
+                # the serve.shed.<tenant> / serve.timeout.<tenant>
+                # counters PR 13 records (bounded label set — the
+                # listen loop caps distinct tenants and aggregates the
+                # rest under "other", so this iteration is bounded too)
+                def ring_delta(prefix: str) -> Dict[str, int]:
+                    def counters(doc):
+                        return (doc.get("metrics") or {}).get(
+                            "counters") or {}
+
+                    new, old = counters(docs[-1]), counters(docs[0])
+                    out: Dict[str, int] = {}
+                    for key, val in new.items():
+                        if not key.startswith(prefix):
+                            continue
+                        try:
+                            delta = int(val) - int(old.get(key, 0))
+                        except (TypeError, ValueError):
+                            continue
+                        # a negative delta means the counter reset
+                        # (restart) inside the ring: the latest value
+                        # IS the growth since then
+                        out[key[len(prefix):]] = (delta if delta >= 0
+                                                  else int(val))
+                    return out
+
+                sheds = ring_delta("serve.shed.")
+                touts = ring_delta("serve.timeout.")
+                for tenant in sorted(set(sheds) | set(touts)):
+                    s, t = sheds.get(tenant, 0), touts.get(tenant, 0)
+                    if s > r["max_shed"] or t > r["max_timeout"]:
+                        alerts.append(Alert(
+                            "tenant_shed", f"{owner}:{tenant}",
+                            r["severity"],
+                            {"shed": s, "timeout": t},
+                            {"max_shed": r["max_shed"],
+                             "max_timeout": r["max_timeout"]},
+                            f"tenant {tenant!r}: {s} shed(s) / {t} "
+                            f"timeout(s) across the snapshot ring "
+                            f"(window of {len(docs)}) — one tenant is "
+                            "eating the admission budget"))
             r = on("tracer_drops")
             tr = latest.get("tracer") or {}
             dropped = (int(tr.get("dropped_spans") or 0)
@@ -332,7 +397,126 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
                     f"work item {subject} has waited {oldest:.0f}s "
                     f"(> {r['max_s']}s): the drain fleet is not "
                     "keeping up"))
+
+    r = on("queue_backlog_burn")
+    if r:
+        bl = backlog_summary(store_dirs, queue_dirs)
+        arrival, drain = bl["arrival_per_s"], bl["drain_per_s"]
+        burning = (arrival >= r["min_arrival_per_s"] and bl["depth"] > 0
+                   and (drain <= 0 or arrival / drain >= r["burn_ratio"]))
+        if burning:
+            alerts.append(Alert(
+                "queue_backlog_burn", "fleet", r["severity"],
+                {"arrival_per_s": arrival, "drain_per_s": drain,
+                 "depth": bl["depth"]},
+                {"burn_ratio": r["burn_ratio"],
+                 "min_arrival_per_s": r["min_arrival_per_s"]},
+                f"cold work arrives at {arrival:.2f}/s but "
+                f"{bl['daemons']} daemon(s) drain {drain:.2f}/s "
+                f"(depth {bl['depth']}): the queue grows without bound "
+                f"— run ~{bl['recommended_daemons']} daemon(s) to "
+                "balance"))
     return alerts
+
+
+def backlog_summary(store_dirs: List[str],
+                    queue_dirs: List[str]) -> Dict[str, Any]:
+    """Arrival-vs-drain economics for the ``queue_backlog_burn`` rule
+    and the follow view's ``burn`` line: arrival/s from reqlog position
+    deltas across each live serve loop's snapshot ring (fallback: the
+    served+shed+timeout counter deltas), fleet drain/s from each live
+    daemon's measured per-item wall clock (status-doc history), queue
+    depth from the work files themselves, and the daemon count that
+    would balance the two.  Read-only and damage-tolerant: unreadable
+    pieces contribute zero, never raise."""
+    import math
+
+    from tenzing_tpu.obs.metrics import snapshot_history
+
+    arrival = 0.0
+    for d in dict.fromkeys(store_dirs):
+        if not os.path.isdir(d):
+            continue
+        try:
+            history = snapshot_history(d)
+        except OSError:
+            continue
+        for _owner, docs in sorted(history.items()):
+            if docs[-1].get("state") == "stopped" or len(docs) < 2:
+                continue
+
+            def seen(doc) -> Optional[float]:
+                rl = doc.get("reqlog")
+                if isinstance(rl, dict) and rl.get("records") is not None:
+                    try:
+                        return float(rl["records"])
+                    except (TypeError, ValueError):
+                        return None
+                c = doc.get("counters")
+                if isinstance(c, dict):
+                    try:
+                        return float(
+                            sum(v for k, v in c.items()
+                                if k.startswith("served_")
+                                or k in ("shed", "timeouts")))
+                    except TypeError:
+                        return None
+                return None
+
+            try:
+                dt = float(docs[-1]["written_at"]) - \
+                    float(docs[0]["written_at"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            n0, n1 = seen(docs[0]), seen(docs[-1])
+            if dt > 0 and n0 is not None and n1 is not None and n1 > n0:
+                arrival += (n1 - n0) / dt
+
+    drain = 0.0
+    daemons = 0
+    walls: List[float] = []
+    for qd in dict.fromkeys(queue_dirs):
+        if not os.path.isdir(qd):
+            continue
+        try:
+            docs = _status_docs(qd)
+        except OSError:
+            continue
+        for st in docs:
+            if st.get("kind") == "serve_loop" or \
+                    st.get("state") == "stopped":
+                continue
+            ws = []
+            for h in st.get("history") or []:
+                try:
+                    w = float(h.get("wall_s"))
+                except (TypeError, ValueError):
+                    continue
+                if w > 0 and h.get("outcome") == "completed":
+                    ws.append(w)
+            daemons += 1
+            if ws:
+                walls += ws
+                drain += 1.0 / (sum(ws) / len(ws))
+
+    depth = 0
+    for qd in dict.fromkeys(queue_dirs):
+        try:
+            depth += sum(1 for n in os.listdir(qd)
+                         if n.startswith("work-") and n.endswith(".json"))
+        except OSError:
+            pass
+
+    per_item_s = (sum(walls) / len(walls)) if walls else None
+    if arrival > 0 and per_item_s:
+        recommended = max(1, int(math.ceil(arrival * per_item_s)))
+    else:
+        recommended = max(1, daemons)
+    return {"arrival_per_s": round(arrival, 3),
+            "drain_per_s": round(drain, 3),
+            "daemons": daemons, "depth": depth,
+            "per_item_s": round(per_item_s, 3) if per_item_s else None,
+            "recommended_daemons": recommended}
 
 
 # -- firing/resolved state machine -------------------------------------------

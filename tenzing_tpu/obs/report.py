@@ -220,10 +220,43 @@ def check_serve_regression(fresh: Dict[str, Any], baseline: Dict[str, Any],
         if f_shed > b_shed:
             reasons.append(f"shed responses grew ({b_shed} -> {f_shed}) "
                            "at the same paced QPS")
+
+    # differential localization (obs/causal.py): when the per-phase
+    # samples are present in both documents, name the segment that moved
+    # — "cache_probe regressed 3.1x" steers a fix; a bare pct99 doesn't
+    from tenzing_tpu.obs.causal import localize_phases
+
+    loc = localize_phases(fresh, baseline, tol=tol)
+    if loc["compared"]:
+        checks["segments"] = loc
+        for m in loc["moved"]:
+            reasons.append(
+                f"phase '{m['segment']}' pct99 regressed "
+                f"{m['ratio']:.1f}x ({m['baseline_pct99_us']:.1f}us -> "
+                f"{m['fresh_pct99_us']:.1f}us)")
+
     verdict = "regression" if reasons else "ok"
     samples = (fresh.get("segmented") or {}).get("exact_samples_us")
     verdict, checks2 = _noise_downgrade(verdict, reasons, samples)
     checks.update(checks2)
+
+    # measured host-noise floors (obs/noise.py): a fresh document from a
+    # materially noisier/quieter host is not comparable — downgrade any
+    # would-be regression rather than blame the code for the scheduler
+    from tenzing_tpu.obs.noise import floor_vs_tail, floors_differ
+
+    f_noise, b_noise = fresh.get("host_noise"), baseline.get("host_noise")
+    fvt = floor_vs_tail(f_noise, f_p99)
+    if fvt is not None:
+        checks["host_noise"] = fvt
+    diff = floors_differ(f_noise, b_noise)
+    if diff is not None:
+        checks["host_floors"] = diff
+        if verdict == "regression":
+            verdict = "inconclusive"
+            reasons.append(
+                f"hosts are not comparable: {diff} — re-measure both "
+                "documents on one host before trusting the regression")
     return {"verdict": verdict, "tol": tol, "reasons": reasons,
             "checks": checks, "family": "serve_trace_replay"}
 
@@ -730,15 +763,34 @@ def reqlog_lines(store_dir: str) -> List[str]:
          if data["damaged"] else ""))
     exemplars = read_exemplars(os.path.join(d, "exemplars"))
     if exemplars:
+        # run the worst requests through the causal analyzer so the
+        # table says WHERE each one's time went, not just how much
+        # (obs/causal.py; ISSUE 16's point of keeping exemplars at all)
+        from tenzing_tpu.obs.causal import analyze_bundles
+
+        chains: Dict[str, str] = {}
+        paths = [ex["path"] for ex in exemplars[:12] if ex.get("path")]
+        if paths:
+            try:
+                for tid, t in analyze_bundles(paths).items():
+                    segs = t.get("segments_us") or {}
+                    if segs:
+                        top = sorted(segs.items(), key=lambda kv: -kv[1])
+                        chains[tid] = ", ".join(
+                            f"{k} {v:.0f}" for k, v in top[:3])
+            except (OSError, ValueError):
+                pass
         lines += ["", "| exemplar (worst requests) | reason | tier | "
-                  "resolve (us) | trace records |", "|---|---|---|---|---|"]
+                  "resolve (us) | top segments (us) |",
+                  "|---|---|---|---|---|"]
         for ex in exemplars[:12]:
             rec = ex.get("record") or {}
+            tid = str(ex.get("trace_id", "?"))
             lines.append(
-                f"| `{str(ex.get('trace_id', '?'))[:16]}` | "
+                f"| `{tid[:16]}` | "
                 f"{ex.get('reason', '?')} | {rec.get('tier', '—')} | "
                 f"{rec.get('resolve_us', '—')} | "
-                f"{ex.get('n_trace_records', 0)} |")
+                f"{chains.get(tid, '—')} |")
     lines.append("")
     return lines
 
@@ -988,9 +1040,42 @@ def fleet_lines(store_dirs: List[str],
                     f"       item age "
                     f"{gauges.get('daemon.item_age_s', 0)}s, lease age "
                     f"{gauges.get('daemon.lease_age_s', 0)}s")
-    # firing alerts last — the line the eye should land on (live
-    # evaluation, read-only; the persisted ledger is rendered beside it)
-    from tenzing_tpu.obs.alerts import firing_lines
+    # arrival-vs-drain backlog economics (obs/alerts.py): the always-on
+    # fleet-sizing line the queue_backlog_burn rule fires from
+    from tenzing_tpu.obs.alerts import backlog_summary, firing_lines
+
+    bl = backlog_summary(store_dirs, queue_dirs)
+    if bl.get("depth") or bl.get("arrival_per_s"):
+        lines.append(
+            f"burn   arrival {bl['arrival_per_s']:.2f}/s vs drain "
+            f"{bl['drain_per_s']:.2f}/s ({bl['daemons']} daemon(s)), "
+            f"depth {bl['depth']}, recommended fleet "
+            f"{bl['recommended_daemons']}")
+    # worst recent exemplar through the causal analyzer: one line of
+    # where the tail's time went, refreshed every tick (obs/causal.py)
+    for d in store_dirs:
+        ex_dir = os.path.join(d, "reqlog", "exemplars")
+        if not os.path.isdir(ex_dir):
+            continue
+        from tenzing_tpu.obs.causal import analyze_bundles
+        from tenzing_tpu.serve.reqlog import read_exemplars
+
+        try:
+            exemplars = read_exemplars(ex_dir)[:4]
+            paths = [ex["path"] for ex in exemplars if ex.get("path")]
+            traces = analyze_bundles(paths) if paths else {}
+        except (OSError, ValueError):
+            continue
+        good = [t for t in traces.values() if t.get("segments_us")]
+        if good:
+            worst = max(good, key=lambda t: t["window_us"])
+            top = sorted(worst["segments_us"].items(),
+                         key=lambda kv: -kv[1])[:3]
+            lines.append(
+                f"causal {worst['trace_id'][:16]}: "
+                f"{worst['window_us']:.0f}us window, "
+                + ", ".join(f"{k} {v:.0f}us" for k, v in top)
+                + f", coverage {worst['coverage']:.0%}")
 
     lines += firing_lines(store_dirs, queue_dirs)
     for d in dict.fromkeys(store_dirs + queue_dirs):
@@ -1013,6 +1098,47 @@ def fleet_lines(store_dirs: List[str],
                    if firing else ""))
     if len(lines) <= 2:
         lines.append("(no status documents found)")
+    lines.append("")
+    return lines
+
+
+def causal_section(bundle_paths: List[str]) -> List[str]:
+    """The causal-observatory section (obs/causal.py,
+    docs/observability.md "Causal analysis"): per-trace critical-path
+    chains over telemetry bundles plus the fleet-wide "where the pct99
+    lives" rollup."""
+    from tenzing_tpu.obs.causal import aggregate, analyze_bundles
+
+    traces = analyze_bundles(bundle_paths)
+    lines = ["## Causal analysis", "",
+             f"- bundles: {len(bundle_paths)}, traces: {len(traces)}"]
+    good = sorted((t for t in traces.values() if "error" not in t),
+                  key=lambda t: -t["window_us"])
+    if good:
+        lines += ["", "| trace | tier | window (us) | queue wait (us) | "
+                  "coverage | chain |", "|---|---|---|---|---|---|"]
+        for t in good[:12]:
+            chain = " > ".join(
+                c["segment"] for c in t["chain"]
+                if c["segment"] != "unattributed")
+            lines.append(
+                f"| `{t['trace_id'][:16]}` | {t['tier']} | "
+                f"{t['window_us']:.0f} | {t['queue_wait_us']:.0f} | "
+                f"{t['coverage']:.0%} | {chain} |")
+        agg = aggregate(traces)
+        rank = agg.get("pct99_ranking") or []
+        if rank:
+            lines += ["", "where the pct99 lives (tail traces, "
+                      f"window >= {agg['pct99_window_us']:.0f}us):"]
+            for r in rank[:6]:
+                lines.append(f"- {r['segment']}: {r['sum_us']:.0f}us "
+                             f"({r['share']:.0%})")
+        dec = agg.get("decomposition") or {}
+        if dec:
+            qw, sv = dec["queue_wait_us"], dec["service_us"]
+            lines.append(
+                f"- queue wait vs service p99: {qw['p99_us']:.0f}us vs "
+                f"{sv['p99_us']:.0f}us")
     lines.append("")
     return lines
 
@@ -1066,6 +1192,9 @@ def build_report(args) -> Tuple[str, Optional[Dict[str, Any]]]:
     stores = _expand(args.store)
     if stores or args.queue_dir:
         lines += store_section(stores, queue_dir=args.queue_dir)
+    causal_globs = _expand(getattr(args, "causal", None))
+    if causal_globs:
+        lines += causal_section(causal_globs)
     if args.check:
         fresh = _load_check_doc(args.check)
         baseline = _load_check_doc(args.baseline)
@@ -1093,6 +1222,11 @@ def build_report(args) -> Tuple[str, Optional[Dict[str, Any]]]:
                   f"- **verdict: {verdict['verdict']}**"]
         for r in verdict["reasons"]:
             lines.append(f"  - {r}")
+        fvt = verdict["checks"].get("host_noise")
+        if isinstance(fvt, dict) and fvt.get("line"):
+            # the measured floor-vs-tail read (obs/noise.py): is the
+            # residual tail the host's fault or the serving path's?
+            lines.append(f"- {fvt['line']}")
         lines += ["", "```json",
                   json.dumps(verdict["checks"], indent=2, sort_keys=True),
                   "```", ""]
@@ -1124,6 +1258,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--queue-dir", default=None, metavar="DIR",
                     help="serving work-queue directory (cold/refinement "
                          "depth by reason)")
+    ap.add_argument("--causal", nargs="*", default=None, metavar="GLOB",
+                    help="telemetry bundles for the per-request "
+                         "critical-path section (obs/causal.py)")
     ap.add_argument("--check", default=None, metavar="FRESH",
                     help="fresh driver JSON for the regression check")
     ap.add_argument("--baseline", default=None, metavar="BASE",

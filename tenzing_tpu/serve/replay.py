@@ -60,7 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.utils.numeric import percentile
 
-REPLAY_VERSION = 4
+REPLAY_VERSION = 5
 # raw exact-tier latency series retained in the result document (replay
 # order preserved): the regression gate's noise-awareness runs the
 # bench/randomness.py runs test over it — and 512 points bound the
@@ -418,6 +418,7 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
                recorded: Optional[Dict[str, Any]] = None,
                pacing: Optional[Dict[str, Any]] = None,
                fleet_scaling: Optional[Dict[str, Any]] = None,
+               noise_samples: int = 64,
                log=None) -> Dict[str, Any]:
     """The whole benchmark; returns the result document (see module
     docstring).  ``trace`` (with its ``recorded`` provenance block, from
@@ -428,6 +429,20 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
     the serving story (resolution latency + drain throughput)."""
     mix = mix or {"exact": 0.8, "near": 0.15, "cold": 0.05}
     workloads = sorted(csv_globs)
+    # measure the host's latency floors BEFORE the replay warms anything:
+    # the quietest read of what a scheduler wake costs here, recorded so
+    # the regression gate can tell a slower host from a slower server
+    # (obs/noise.py, docs/observability.md "Causal analysis")
+    host_noise = None
+    if noise_samples > 0:
+        from tenzing_tpu.obs.noise import probe_host_noise
+        host_noise = probe_host_noise(samples=noise_samples)
+        if log:
+            w = host_noise["timer_wake_us"]
+            s = host_noise["hot_spin_us"]
+            log(f"replay: host noise floors — timer-wake p50 "
+                f"{w['p50_us']:.1f}us p99 {w['p99_us']:.1f}us, hot-spin "
+                f"p50 {s['p50_us']:.1f}us p99 {s['p99_us']:.1f}us")
     own_workdir = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="tz_serve_replay.")
     try:
@@ -465,6 +480,7 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
                                 "default is clamped to it"},
                            **(pacing or {})),
             "warm": stores["warm"],
+            **({"host_noise": host_noise} if host_noise else {}),
             **({"recorded": recorded} if recorded else {}),
             "monolithic": legacy,
             "segmented": seg,
@@ -517,6 +533,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--max-pending", type=int, default=256)
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--request-timeout", type=float, default=30.0)
+    ap.add_argument("--noise-samples", type=int, default=64,
+                    help="host-noise floor probe samples stamped into "
+                         "the result's host_noise block (0 disables; "
+                         "obs/noise.py)")
     ap.add_argument("--workdir", default=None,
                     help="keep stores/queues here (default: temp, "
                          "removed)")
@@ -575,7 +595,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                      record_dir=args.record, trace=trace,
                      recorded=recorded,
                      pacing={"source": pacing_source},
-                     fleet_scaling=fleet_scaling, log=log)
+                     fleet_scaling=fleet_scaling,
+                     noise_samples=args.noise_samples, log=log)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)

@@ -19,6 +19,7 @@ from tenzing_tpu.obs.alerts import (
     AlertBook,
     AlertTreeError,
     DEFAULT_RULES,
+    backlog_summary,
     burn_of,
     evaluate,
     firing_lines,
@@ -149,6 +150,120 @@ def test_shed_rate_queue_wait_and_tracer_drops(tree):
     assert alerts["shed_rate"].value == 3.5
     assert alerts["queue_age"].subject == "hot:pending"
     assert alerts["tracer_drops"].value == 9
+
+
+def _snap_raw(d, owner, seq, counters=None, reqlog=None, state="serving",
+              now=NOW):
+    doc = {"kind": "metrics_snapshot", "owner": owner, "seq": seq,
+           "written_at": now - (10 - seq), "state": state,
+           "metrics": {"counters": counters or {}, "gauges": {},
+                       "histograms": {}},
+           "tracer": {"dropped_spans": 0, "dropped_events": 0}}
+    if reqlog is not None:
+        doc["reqlog"] = reqlog
+    json.dump(doc, open(os.path.join(d, f"metrics-{owner}-{seq}.json"),
+                        "w"))
+
+
+def test_tenant_shed_rule_fires_on_ring_growth(tree):
+    store, queue = tree
+    # tenant "acme" sheds grow 2 -> 9 across the ring, "other" (the
+    # capped-set aggregate label) collects a timeout; "quiet" is flat
+    _snap_raw(store, "loop", 0, counters={"serve.shed.acme": 2,
+                                          "serve.shed.quiet": 5})
+    _snap_raw(store, "loop", 3, counters={"serve.shed.acme": 9,
+                                          "serve.shed.quiet": 5,
+                                          "serve.timeout.other": 1})
+    alerts = evaluate([store], [queue], now=NOW)
+    assert sorted(a.key for a in alerts) == \
+        ["tenant_shed:loop:acme", "tenant_shed:loop:other"]
+    acme = next(a for a in alerts if a.subject == "loop:acme")
+    assert acme.value == {"shed": 7, "timeout": 0}
+    assert acme.severity == "ticket"
+    assert "acme" in acme.message
+
+
+def test_tenant_shed_counter_reset_and_thresholds(tree):
+    store, queue = tree
+    # a counter reset (restart inside the ring) must read as "latest
+    # value since the reset", never a negative delta that hides growth
+    _snap_raw(store, "loop", 0, counters={"serve.shed.acme": 50})
+    _snap_raw(store, "loop", 3, counters={"serve.shed.acme": 3})
+    alerts = evaluate([store], [queue], now=NOW)
+    assert [a.key for a in alerts] == ["tenant_shed:loop:acme"]
+    assert alerts[0].value == {"shed": 3, "timeout": 0}
+    # a raised budget (--set tenant_shed.max_shed=5) tolerates it
+    rules = load_rules(sets=["tenant_shed.max_shed=5"])
+    assert evaluate([store], [queue], rules=rules, now=NOW) == []
+
+
+def _daemon_status(qd, owner, history, state="draining", now=NOW):
+    json.dump({"owner": owner, "pid": 1, "state": state,
+               "heartbeat_at": now, "history": history},
+              open(os.path.join(qd, f"status-{owner}.json"), "w"))
+
+
+def test_backlog_summary_and_burn_rule(tree):
+    store, queue = tree
+    # arrival: the reqlog position advances 0 -> 30 records across a
+    # 3s ring window -> 10/s
+    _snap_raw(store, "loop", 0, reqlog={"records": 0, "segments": 1})
+    _snap_raw(store, "loop", 3, reqlog={"records": 30, "segments": 1})
+    # drain: one live daemon completing items in 2s each -> 0.5/s
+    _daemon_status(queue, "d1", [
+        {"exact": "e", "outcome": "completed", "wall_s": 2.0},
+        {"exact": "e", "outcome": "completed", "wall_s": 2.0},
+        {"exact": "e", "outcome": "failed", "wall_s": 99.0},  # excluded
+    ])
+    json.dump({"kind": "search_request"},
+              open(os.path.join(queue, "work-x.json"), "w"))
+    bl = backlog_summary([store], [queue])
+    assert bl["arrival_per_s"] == 10.0
+    assert bl["drain_per_s"] == 0.5
+    assert bl["daemons"] == 1 and bl["depth"] == 1
+    assert bl["per_item_s"] == 2.0
+    assert bl["recommended_daemons"] == 20  # ceil(10/s * 2s/item)
+    alerts = [a for a in evaluate([store], [queue], now=NOW)
+              if a.rule == "queue_backlog_burn"]
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a.subject == "fleet" and a.severity == "page"
+    assert a.value["arrival_per_s"] == 10.0
+    assert "~20 daemon(s)" in a.message
+
+
+def test_backlog_burn_needs_depth_and_arrival(tree):
+    store, queue = tree
+    # arrival without queued work: the fleet is keeping up — no alert
+    _snap_raw(store, "loop", 0, reqlog={"records": 0})
+    _snap_raw(store, "loop", 3, reqlog={"records": 30})
+    assert [a.rule for a in evaluate([store], [queue], now=NOW)] == []
+    # queued work without measurable arrival: queue_age owns that
+    # story, the burn rule stays silent
+    for n in os.listdir(store):
+        os.unlink(os.path.join(store, n))
+    json.dump({"kind": "search_request"},
+              open(os.path.join(queue, "work-x.json"), "w"))
+    assert [a.rule for a in evaluate([store], [queue], now=NOW)] == []
+
+
+def test_backlog_burn_balanced_fleet_does_not_fire(tree):
+    store, queue = tree
+    _snap_raw(store, "loop", 0, reqlog={"records": 0})
+    _snap_raw(store, "loop", 3, reqlog={"records": 3})  # 1/s
+    # two daemons at 1s/item drain 2/s > 1.2 * arrival — healthy
+    _daemon_status(queue, "d1", [{"outcome": "completed", "wall_s": 1.0}])
+    _daemon_status(queue, "d2", [{"outcome": "completed", "wall_s": 1.0}])
+    json.dump({"kind": "search_request"},
+              open(os.path.join(queue, "work-x.json"), "w"))
+    assert [a.rule for a in evaluate([store], [queue], now=NOW)] == []
+    # a stopped daemon stops counting toward the fleet's drain rate
+    _daemon_status(queue, "d1", [{"outcome": "completed", "wall_s": 1.0}],
+                   state="stopped")
+    _daemon_status(queue, "d2", [{"outcome": "completed", "wall_s": 1.0}],
+                   state="stopped")
+    fired = [a.rule for a in evaluate([store], [queue], now=NOW)]
+    assert "queue_backlog_burn" in fired
 
 
 def test_missing_tree_is_usage_error(tmp_path):

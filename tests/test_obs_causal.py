@@ -1,0 +1,378 @@
+"""Causal latency observatory (ISSUE 16; docs/observability.md "Causal
+analysis"): golden hand-computed critical paths over synthetic span
+bundles (fast-path hit, exclusive-path hit, cold item through queue
+wait + drain child + merge, batch members sharing one trace_id),
+explicit ``unattributed`` residual accounting, the fleet-wide
+aggregation, the differential localizer's ok/flag/floor/noise-downgrade
+verdicts, and the ``python -m tenzing_tpu.obs.causal`` CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from tenzing_tpu.obs.causal import (
+    CAUSAL_VERSION,
+    aggregate,
+    analyze_bundles,
+    analyze_records,
+    localize_phases,
+    localize_segments,
+)
+from tenzing_tpu.obs.report import check_serve_regression
+
+
+def span(name, ts, dur, tid="t1", **attrs):
+    return {"kind": "span", "name": name, "ts_us": float(ts),
+            "dur_us": float(dur), "pid": 1, "tid": 1,
+            "attrs": {"trace_id": tid, **attrs}}
+
+
+def event(name, ts, tid="t1", **attrs):
+    return {"kind": "event", "name": name, "ts_us": float(ts),
+            "pid": 1, "tid": 1, "attrs": {"trace_id": tid, **attrs}}
+
+
+def chain_of(trace):
+    return [c["segment"] for c in trace["chain"]]
+
+
+# -- golden critical paths ---------------------------------------------------
+
+def test_exclusive_path_hit_golden():
+    # serve.query [100, 400] wrapping fingerprint [110,150] and
+    # cache_probe [160,260]: the remainder of the query window is
+    # store_walk, the lead-in is ingress — every us attributed
+    recs = [
+        span("serve.query", 100, 300, tier="exact", workload="halo"),
+        span("serve.fingerprint", 110, 40),
+        span("serve.cache_probe", 160, 100),
+    ]
+    t = analyze_records(recs)["t1"]
+    assert chain_of(t) == ["ingress", "fingerprint", "store_walk",
+                           "cache_probe", "store_walk"]
+    assert t["segments_us"] == {"ingress": 10.0, "fingerprint": 40.0,
+                                "store_walk": 150.0, "cache_probe": 100.0}
+    assert t["window_us"] == 300.0
+    assert t["unattributed_us"] == 0.0 and t["coverage"] == 1.0
+    assert t["tier"] == "exact" and t["queries"] == 1
+
+
+def test_fast_path_hit_golden():
+    # the fast path emits its span post-hoc with ~0 duration; the real
+    # latency rides resolve_us — the analyzer synthesizes the interval
+    recs = [span("serve.query", 500, 0, tier="exact", fast_path=True,
+                 resolve_us=42)]
+    t = analyze_records(recs)["t1"]
+    assert chain_of(t) == ["fast_path"]
+    assert t["segments_us"] == {"fast_path": 42.0}
+    assert t["window_us"] == 42.0 and t["coverage"] == 1.0
+
+
+def test_cold_item_through_queue_wait_drain_merge_golden():
+    # the full cold chain: resolve [0,300] enqueues at 250, a daemon
+    # claims at 1000 (queue wait 750), drains with compile/measure
+    # children, merges [4500,4900] — the window ends at the servable
+    # point, not at post-merge housekeeping
+    recs = [
+        span("serve.query", 0, 300, tier="cold", workload="spmv"),
+        span("serve.fingerprint", 10, 40),
+        span("serve.cache_probe", 60, 100),
+        event("serve.enqueue", 250, exact="e1", reason="cold"),
+        span("daemon.drain", 1000, 4500, exact="e1"),
+        span("executor.compile", 1100, 900),
+        span("bench.benchmark", 2100, 900),
+        span("serve.store.flush", 4500, 400),
+    ]
+    t = analyze_records(recs)["t1"]
+    assert chain_of(t) == [
+        "ingress", "fingerprint", "store_walk", "cache_probe",
+        "store_walk", "queue_wait", "drain", "compile", "drain",
+        "measure", "drain", "merge"]
+    assert t["segments_us"]["queue_wait"] == 750.0
+    assert t["segments_us"]["merge"] == 400.0
+    assert t["window_us"] == 4900.0  # ends at the merge, not drain end
+    assert t["servable"] is True
+    assert t["coverage"] == 1.0 and t["unattributed_us"] == 0.0
+    assert t["markers"] == [{"segment": "enqueue", "ts_us": 250.0}]
+    assert t["queue_wait_us"] == 750.0
+    assert t["service_us"] == 4150.0  # window - queue wait (no residual)
+    # ISSUE 16 acceptance shape: enqueue -> queue wait -> drain -> merge
+    # in order, queue wait a distinct segment, coverage >= 0.9
+    segs = chain_of(t)
+    assert [s for s in segs if s in ("queue_wait", "merge")] == \
+        ["queue_wait", "merge"]
+    assert segs.index("queue_wait") < segs.index("drain")
+    assert t["coverage"] >= 0.9
+
+
+def test_batch_members_share_trace_and_residual_accounts():
+    # two queries in one trace with an uncovered gap between them: the
+    # gap is explicit unattributed, and the books balance exactly —
+    # sum(segments) + unattributed == window
+    recs = [
+        span("serve.query", 0, 100, tier="exact"),
+        span("serve.fingerprint", 10, 80),
+        span("serve.query", 300, 100, tier="exact"),
+        span("serve.fingerprint", 310, 80),
+    ]
+    t = analyze_records(recs)["t1"]
+    assert t["queries"] == 2
+    assert chain_of(t) == ["ingress", "fingerprint", "store_walk",
+                           "unattributed",
+                           "ingress", "fingerprint", "store_walk"]
+    assert t["window_us"] == 400.0
+    assert t["unattributed_us"] == 200.0
+    assert t["coverage"] == 0.5
+    total = sum(t["segments_us"].values()) + t["unattributed_us"]
+    assert abs(total - t["window_us"]) < 1e-6
+    # and the chain itself tiles the window with no gaps or overlaps
+    edges = [(c["start_us"], c["end_us"]) for c in t["chain"]]
+    assert edges[0][0] == 0.0 and edges[-1][1] == t["window_us"]
+    assert all(a[1] == b[0] for a, b in zip(edges, edges[1:]))
+
+
+def test_traces_separated_and_housekeeping_dropped():
+    recs = [
+        span("serve.query", 0, 100, tid="a", tier="exact"),
+        span("serve.query", 0, 200, tid="b", tier="near"),
+        # no trace_id: process-local housekeeping, not request latency
+        {"kind": "span", "name": "serve.query", "ts_us": 0.0,
+         "dur_us": 999.0, "attrs": {}},
+        {"kind": "other", "name": "noise"},
+    ]
+    out = analyze_records(recs)
+    assert sorted(out) == ["a", "b"]
+    assert out["a"]["window_us"] == 100.0
+    assert out["b"]["tier"] == "near"
+
+
+# -- aggregation -------------------------------------------------------------
+
+def test_aggregate_rollup_and_pct99_ranking():
+    recs = []
+    # nine quick fast-path hits and one slow cold request: the tail
+    # ranking must attribute the pct99 to the cold chain's segments
+    for i in range(9):
+        recs.append(span("serve.query", 1000 * i, 0, tid=f"f{i}",
+                         tier="exact", fast_path=True, resolve_us=50))
+    recs += [
+        span("serve.query", 0, 300, tid="cold1", tier="cold"),
+        event("serve.enqueue", 250, tid="cold1"),
+        span("daemon.drain", 1000, 4000, tid="cold1"),
+        span("serve.store.flush", 4500, 500, tid="cold1"),
+    ]
+    traces = analyze_records(recs)
+    agg = aggregate(traces)
+    assert agg["n_traces"] == 10
+    assert agg["by_tier"]["exact"]["count"] == 9
+    assert agg["by_tier"]["exact"]["segments_us"]["fast_path"][
+        "p50_us"] == 50.0
+    assert agg["by_tier"]["cold"]["count"] == 1
+    top = agg["pct99_ranking"][0]
+    assert top["segment"] in ("drain", "queue_wait")
+    assert agg["decomposition"]["queue_wait_us"]["p99_us"] == 750.0
+
+
+# -- differential localization -----------------------------------------------
+
+def _phase(p99, count=64):
+    return {"count": count, "pct50_us": p99 / 2, "pct99_us": p99,
+            "sum_us": p99 * count}
+
+
+def test_localizer_ok_when_nothing_moved():
+    base = {"fingerprint": _phase(10.0), "cache_probe": _phase(20.0)}
+    fresh = {"fingerprint": _phase(12.0), "cache_probe": _phase(21.0)}
+    loc = localize_segments(fresh, base)
+    assert loc["moved"] == []
+    assert {c["segment"] for c in loc["compared"]} == \
+        {"fingerprint", "cache_probe"}
+
+
+def test_localizer_flags_the_moved_segment():
+    base = {"fingerprint": _phase(10.0), "cache_probe": _phase(20.0)}
+    fresh = {"fingerprint": _phase(11.0), "cache_probe": _phase(62.0)}
+    loc = localize_segments(fresh, base)
+    assert [m["segment"] for m in loc["moved"]] == ["cache_probe"]
+    assert loc["moved"][0]["ratio"] == 3.1
+
+
+def test_localizer_noise_guards():
+    # a 3x ratio on a sub-floor phase is not movement (2us -> 6us sits
+    # under the 5us absolute floor), nor is a thin sample (count < 8),
+    # and a raised measured wake floor suppresses small deltas too
+    base = {"tiny": _phase(2.0), "thin": _phase(10.0, count=3),
+            "real": _phase(10.0)}
+    fresh = {"tiny": _phase(6.0), "thin": _phase(90.0, count=3),
+             "real": _phase(30.0)}
+    loc = localize_segments(fresh, base)
+    assert [m["segment"] for m in loc["moved"]] == ["real"]
+    assert "thin" in loc["skipped"]
+    # same data under a 25us measured floor: real's 20us delta is
+    # within the host's own wake noise — nothing moved
+    loc = localize_segments(fresh, base, floor_us=25.0)
+    assert loc["moved"] == [] and loc["delta_floor_us"] == 25.0
+
+
+def _serve_doc(pct99=100.0, phases=None, samples=None, noise_p99=None):
+    doc = {
+        "kind": "serve_trace_replay",
+        "segmented": {
+            "resolve_us": {"exact": {"count": 64, "pct50_us": 50.0,
+                                     "pct99_us": pct99}},
+            "verifier_calls": 0, "shed": 0,
+            "exact_samples_us": samples or [],
+            **({"phases_us": phases} if phases else {}),
+        },
+    }
+    if noise_p99 is not None:
+        doc["host_noise"] = {
+            "version": 1, "samples": 64, "host": "h",
+            "timer_wake_us": {"count": 64, "p50_us": noise_p99 / 2,
+                              "p99_us": noise_p99, "runs_z": 0.1,
+                              "iid": True},
+            "hot_spin_us": {"count": 64, "p50_us": 1.0, "p99_us": 2.0,
+                            "runs_z": 0.1, "iid": True},
+        }
+    return doc
+
+
+def test_localize_phases_uses_fresh_doc_wake_floor():
+    base = _serve_doc(phases={"cache_probe": _phase(10.0)})
+    fresh = _serve_doc(phases={"cache_probe": _phase(30.0)},
+                       noise_p99=25.0)
+    # delta 20us < the recorded 25us wake floor: not movement
+    assert localize_phases(fresh, base)["moved"] == []
+    fresh = _serve_doc(phases={"cache_probe": _phase(120.0)},
+                       noise_p99=25.0)
+    assert [m["segment"] for m in localize_phases(fresh, base)["moved"]] \
+        == ["cache_probe"]
+
+
+def _iid_samples(n=64, seed=1):
+    # seeded uniform jitter: passes the runs test (|Z| < 1.96), so the
+    # noise downgrade stays out of the way of the verdict under test
+    import random
+
+    rng = random.Random(seed)
+    return [90.0 + rng.random() * 2 for _ in range(n)]
+
+
+def test_serve_gate_names_the_doctored_phase():
+    # ISSUE 16 acceptance: the gate says WHICH phase regressed, not
+    # just that a pct99 did
+    samples = _iid_samples()
+    base = _serve_doc(phases={"fingerprint": _phase(10.0),
+                              "cache_probe": _phase(20.0)})
+    fresh = _serve_doc(pct99=100.0,
+                       phases={"fingerprint": _phase(10.5),
+                               "cache_probe": _phase(65.0)},
+                       samples=samples)
+    verdict = check_serve_regression(fresh, base)
+    assert verdict["verdict"] == "regression"
+    assert any("phase 'cache_probe' pct99 regressed 3.2x" in r
+               for r in verdict["reasons"])
+    assert [m["segment"] for m in
+            verdict["checks"]["segments"]["moved"]] == ["cache_probe"]
+
+
+def test_serve_gate_downgrades_cross_host_comparison():
+    # same doctored regression, but the fresh doc's measured floors are
+    # 10x the baseline host's: the hosts are not comparable — verdict
+    # downgrades to inconclusive instead of blaming the code
+    samples = _iid_samples()
+    base = _serve_doc(phases={"cache_probe": _phase(20.0)}, noise_p99=5.0)
+    fresh = _serve_doc(pct99=400.0,
+                       phases={"cache_probe": _phase(200.0)},
+                       samples=samples, noise_p99=50.0)
+    verdict = check_serve_regression(fresh, base)
+    assert verdict["verdict"] == "inconclusive"
+    assert any("hosts are not comparable" in r for r in verdict["reasons"])
+    assert "timer-wake" in verdict["checks"]["host_floors"]
+    # the floor-vs-tail read is recorded for the report to render
+    assert verdict["checks"]["host_noise"]["ratio"] == 8.0
+    assert "serving-bound" in verdict["checks"]["host_noise"]["line"]
+
+
+def test_serve_gate_matching_hosts_do_not_downgrade():
+    base = _serve_doc(noise_p99=10.0)
+    fresh = _serve_doc(pct99=95.0, noise_p99=12.0)
+    verdict = check_serve_regression(fresh, base)
+    assert verdict["verdict"] == "ok"
+    assert "host_floors" not in verdict["checks"]
+
+
+# -- bundles + CLI -----------------------------------------------------------
+
+def _write_bundle(path, recs, header=None):
+    with open(path, "w") as f:
+        if header is not None:
+            f.write(json.dumps(header) + "\n")
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_analyze_bundles_exemplar_header_supplies_tenant(tmp_path):
+    p = str(tmp_path / "exemplar-aa-slow-0.jsonl")
+    _write_bundle(p, [span("serve.query", 0, 100, tid="aa", tier="exact"),
+                      span("serve.fingerprint", 10, 80, tid="aa")],
+                  header={"kind": "exemplar", "trace_id": "aa",
+                          "record": {"tenant": "acme",
+                                     "resolve_us": 100.0}})
+    out = analyze_bundles([p])
+    assert out["aa"]["tenant"] == "acme"
+    agg = aggregate(out)
+    assert agg["by_tenant"]["acme"]["count"] == 1
+
+
+def test_causal_cli_analysis_and_diff(tmp_path):
+    bundle = str(tmp_path / "trace.jsonl")
+    _write_bundle(bundle, [
+        span("serve.query", 0, 300, tier="cold"),
+        event("serve.enqueue", 250),
+        span("daemon.drain", 1000, 4000),
+        span("serve.store.flush", 4500, 500),
+    ])
+    out = str(tmp_path / "causal.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "tenzing_tpu.obs.causal", bundle,
+         "--out", out], capture_output=True, text=True, timeout=120,
+        env=env)
+    assert r.returncode == 0, r.stderr
+    doc = json.load(open(out))
+    assert doc["kind"] == "causal_analysis"
+    assert doc["version"] == CAUSAL_VERSION
+    t = doc["traces"]["t1"]
+    assert t["coverage"] >= 0.9
+    segs = [c["segment"] for c in t["chain"]]
+    assert segs.index("queue_wait") < segs.index("drain") < \
+        segs.index("merge")
+    # --diff: doctored phase -> exit 1, names the segment
+    base_doc = _serve_doc(phases={"cache_probe": _phase(20.0)})
+    fresh_doc = _serve_doc(phases={"cache_probe": _phase(65.0)})
+    fb, bb = str(tmp_path / "f.json"), str(tmp_path / "b.json")
+    json.dump(fresh_doc, open(fb, "w"))
+    json.dump(base_doc, open(bb, "w"))
+    r = subprocess.run(
+        [sys.executable, "-m", "tenzing_tpu.obs.causal",
+         "--diff", fb, bb], capture_output=True, text=True, timeout=120,
+        env=env)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    diff = json.loads(r.stdout)
+    assert diff["kind"] == "causal_diff"
+    assert [m["segment"] for m in diff["moved"]] == ["cache_probe"]
+    # clean pair -> exit 0
+    json.dump(base_doc, open(fb, "w"))
+    r = subprocess.run(
+        [sys.executable, "-m", "tenzing_tpu.obs.causal",
+         "--diff", fb, bb], capture_output=True, text=True, timeout=120,
+        env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # no bundles and no --diff: usage error
+    r = subprocess.run(
+        [sys.executable, "-m", "tenzing_tpu.obs.causal"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 2

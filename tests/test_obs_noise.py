@@ -1,0 +1,123 @@
+"""Measured host-noise floors (ISSUE 16; docs/observability.md "Causal
+analysis"): deterministic probe math against a scripted clock, the
+``host_noise`` block's shape, the re-probe-on-runs-test-failure
+discipline, and the floor comparison verdicts (:func:`floors_differ`,
+:func:`floor_vs_tail`).
+"""
+
+from tenzing_tpu.obs.noise import (
+    NOISE_VERSION,
+    floor_vs_tail,
+    floors_differ,
+    probe_host_noise,
+    probe_hot_spin,
+    probe_timer_wake,
+    series_summary,
+)
+
+
+class ScriptedClock:
+    """A fake ``perf_counter``/``sleep`` pair: sleep advances by the
+    request plus a scripted overshoot, each clock() read costs a fixed
+    tick — the probes become pure arithmetic."""
+
+    def __init__(self, overshoots_us, tick_us=1.0):
+        self.overshoots = list(overshoots_us)
+        self.tick_us = tick_us
+        self.t = 0.0
+        self.i = 0
+
+    def clock(self):
+        self.t += self.tick_us / 1e6
+        return self.t
+
+    def sleep(self, secs):
+        over = self.overshoots[self.i % len(self.overshoots)]
+        self.i += 1
+        self.t += secs + over / 1e6
+
+
+def test_probe_timer_wake_deterministic_golden():
+    c = ScriptedClock([10.0, 20.0, 30.0, 40.0])
+    xs = probe_timer_wake(samples=4, sleep_us=100.0, clock=c.clock,
+                          sleeper=c.sleep)
+    # each sample: requested + scripted overshoot + one clock tick
+    # (float-second arithmetic reintroduces ~1e-10us rounding)
+    assert [round(x, 6) for x in xs] == [11.0, 21.0, 31.0, 41.0]
+
+
+def test_probe_hot_spin_shape_and_overshoot_bound():
+    c = ScriptedClock([], tick_us=5.0)
+    xs = probe_hot_spin(samples=3, target_us=20.0, clock=c.clock)
+    # ticks of 5us against a 20us deadline: first read past the
+    # deadline overshoots by < one tick + alignment
+    assert len(xs) == 3
+    assert all(0.0 <= x <= 10.0 for x in xs)
+
+
+def test_series_summary_shape():
+    s = series_summary([1.0, 2.0, 3.0, 4.0])
+    assert set(s) == {"count", "p50_us", "p99_us", "mean_us", "max_us",
+                      "runs_z", "iid"}
+    assert s["count"] == 4 and s["max_us"] == 4.0
+    assert s["mean_us"] == 2.5
+    assert isinstance(s["iid"], bool)
+
+
+def test_probe_host_noise_block_shape():
+    c = ScriptedClock([3.0, 7.0, 5.0, 9.0, 2.0, 8.0, 4.0, 6.0])
+    block = probe_host_noise(samples=16, clock=c.clock, sleeper=c.sleep)
+    assert block["version"] == NOISE_VERSION
+    assert block["samples"] == 16
+    assert block["timer_wake_us"]["count"] == 16
+    assert block["hot_spin_us"]["count"] == 16
+    assert block["attempts"] >= 1
+    assert isinstance(block["host"], str) and block["host"]
+    assert block["measured_at"] > 0
+
+
+def test_probe_host_noise_reprobes_on_runs_failure():
+    # a monotone overshoot ramp fails the runs test every pass: the
+    # probe retries, records the last pass, and says so via attempts
+    # + iid=False — a noisy floor measurement is visible, not hidden
+    c = ScriptedClock([float(i) for i in range(32)])
+    block = probe_host_noise(samples=32, retries=2, clock=c.clock,
+                             sleeper=c.sleep)
+    assert block["attempts"] == 3
+    assert block["timer_wake_us"]["iid"] is False
+
+
+def _block(wake_p99, spin_p99=2.0):
+    return {"timer_wake_us": {"p99_us": wake_p99, "p50_us": wake_p99 / 2},
+            "hot_spin_us": {"p99_us": spin_p99, "p50_us": spin_p99 / 2}}
+
+
+def test_floors_differ_verdicts():
+    # close floors: comparable
+    assert floors_differ(_block(10.0), _block(15.0)) is None
+    # 5x wake floor gap (either direction): incomparable, and the
+    # reason names the probe
+    r = floors_differ(_block(50.0), _block(10.0))
+    assert r is not None and "timer-wake" in r
+    assert floors_differ(_block(10.0), _block(50.0)) is not None
+    # hot-spin gap alone is enough
+    r = floors_differ(_block(10.0, spin_p99=40.0), _block(10.0))
+    assert r is not None and "hot-spin" in r
+    # sub-1us floors are clamped: clock-granularity jitter cannot
+    # manufacture a "different host"
+    assert floors_differ(_block(10.0, spin_p99=0.01),
+                         _block(10.0, spin_p99=0.9)) is None
+    # a missing block never claims a host difference
+    assert floors_differ(None, _block(10.0)) is None
+    assert floors_differ(_block(10.0), {}) is None
+
+
+def test_floor_vs_tail_verdicts():
+    v = floor_vs_tail(_block(26.0), 98.8)
+    assert v["ratio"] == 3.8
+    assert v["host_bound"] is True
+    assert "host-bound" in v["line"]
+    v = floor_vs_tail(_block(10.0), 500.0)
+    assert v["host_bound"] is False and "serving-bound" in v["line"]
+    assert floor_vs_tail(None, 100.0) is None
+    assert floor_vs_tail(_block(10.0), None) is None

@@ -153,6 +153,12 @@ def test_prefetch_issue_hit_wasted_accounting(corpus, registry, tracer):
         for o in terminals[:2]:
             p.benchmark(o, None)
         assert p.hits == 2
+        # let the third (speculative) compile land before close(): on a
+        # loaded host it can still be queued, and close() cancels queued
+        # work — which would (correctly) report wasted()==0
+        deadline = time.time() + 10.0
+        while p.wasted() < 1 and time.time() < deadline:
+            time.sleep(0.01)
     finally:
         p.close()
     assert p.issued == 3 and p.wasted() == 1 and p.failed == 0
