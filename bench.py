@@ -186,6 +186,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "driver stamps the ``perf.chunked`` provenance "
                          "block (menus, searched/chosen counts, hidden "
                          "comm estimated vs measured)")
+    ap.add_argument("--synth-collectives", action="store_true",
+                    help="searchable synthesized collectives "
+                         "(docs/performance.md, 'Synthesized collectives'): "
+                         "decompose the workload's collective exchanges "
+                         "into chunk-routed point-to-point sketches over "
+                         "the mesh/host topology (collectives/synth.py) "
+                         "and put each priced instantiation next to the "
+                         "fixed engine in one ChooseOp; the solvers search "
+                         "them like any kernel menu, the independent "
+                         "verifier certifies every synthesized projection, "
+                         "and the driver stamps the ``perf.synth`` "
+                         "provenance block (menus, searched/chosen "
+                         "sketches, est vs measured comm, verdict)")
     ap.add_argument("--no-verify", action="store_true",
                     help="disable the independent schedule-soundness "
                          "verifier (docs/robustness.md): the guard in the "

@@ -133,6 +133,7 @@ class DriverRequest:
     fuse_winner: bool = False
     fuse_search_tiles: bool = False
     chunk: bool = False
+    synth_collectives: bool = False
     no_verify: bool = False
     verify_tol: float = 0.02
 
@@ -274,8 +275,12 @@ def build_spmv(args):
     m = args.m if args.m is not None else (512 if args.smoke else 150_000)
     # --spmv-bw widens the band, growing the remote-column exchange relative
     # to the local compute: the transfer-bound sweep of VERDICT r2 item 7
-    bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, bw=args.spmv_bw, seed=0)
-    jbufs = TraceExecutor.place_host_buffers(bufs, spmv_host_buffer_names())
+    synth = bool(args.synth_collectives)
+    bufs, _ = make_spmv_buffers(m=m, nnz_per_row=10, bw=args.spmv_bw, seed=0,
+                                synth=synth)
+    n_rem = int(bufs["x_remote"].shape[0])
+    jbufs = TraceExecutor.place_host_buffers(
+        bufs, spmv_host_buffer_names(n_rem, synth=synth))
     # impl_choice: the kernel menu (XLA gather vs Pallas vreg-gather) is part
     # of the searched space alongside order and lane assignment; known x sizes
     # prune Pallas choices that would only alias the XLA path (ADVICE r1).
@@ -284,7 +289,9 @@ def build_spmv(args):
     # real transfer to hide behind the local SpMV
     x_sizes = {"x_local": int(jbufs["x_local"].shape[0]),
                "x_remote": int(jbufs["x_remote"].shape[0])}
-    mk = lambda: SpMVCompound(impl_choice=True, x_sizes=x_sizes, exchange="host")
+    mk = lambda: SpMVCompound(impl_choice=True, x_sizes=x_sizes,
+                              exchange="host", synth=synth,
+                              synth_relax=args.smoke)
     g = Graph()
     g.start_then(mk())
     g.then_finish(mk())
@@ -444,12 +451,14 @@ def graph_for(req: DriverRequest):
         from tenzing_tpu.models.spmv import SpMVCompound, make_spmv_buffers
 
         s = workload_shape(req)
+        synth = bool(req.synth_collectives)
         bufs, _ = make_spmv_buffers(m=s["m"], nnz_per_row=s["nnz_per_row"],
-                                    bw=req.spmv_bw, seed=0)
+                                    bw=req.spmv_bw, seed=0, synth=synth)
         x_sizes = {"x_local": int(bufs["x_local"].shape[0]),
                    "x_remote": int(bufs["x_remote"].shape[0])}
         mk = lambda: SpMVCompound(impl_choice=True, x_sizes=x_sizes,
-                                  exchange="host")
+                                  exchange="host", synth=synth,
+                                  synth_relax=req.smoke)
         g = Graph()
         g.start_then(mk())
         g.then_finish(mk())
@@ -2045,6 +2054,98 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
             chunked_block = {
                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
 
+    # synthesized-collective provenance (ISSUE 17, docs/performance.md
+    # "Synthesized collectives"): the priced-and-pruned sketch menus each
+    # exchange site offered, what the search visited and chose, analytic
+    # est vs measured hidden comm of the chosen decomposition, and the
+    # result-integrity verdict on the reported projection.  Provenance
+    # only: a failure degrades to an error-carrying block.
+    synth_block = None
+    if args.synth_collectives:
+        try:
+            from tenzing_tpu.collectives.synth import (
+                synth_hidden_comm_measured_us,
+                synth_menus,
+                synths_of,
+            )
+
+            smenus = synth_menus(g)
+            schosen = synths_of(reported_seq)
+            searched_sketches: set = set()
+            n_cand_synth = 0
+            for s in res.sims:
+                sm = synths_of(s.order)
+                if sm:
+                    n_cand_synth += 1
+                    searched_sketches.update(
+                        f"{v['sketch']}.c{v['chunks']}" for v in sm.values())
+            sest_total = 0.0
+            for base, v in schosen.items():
+                m = smenus.get(base)
+                if m:
+                    sest_total += float(m.get("est_us", {}).get(
+                        f"{v['sketch']}.c{v['chunks']}", 0.0))
+            synth_block = {
+                "menus": {
+                    b: {"menu": list(m["menu"]),
+                        "est_us": {k: round(float(v2), 3)
+                                   for k, v2 in m.get("est_us", {}).items()},
+                        "pruned": dict(m.get("pruned", {})),
+                        "note": m.get("note", "")}
+                    for b, m in sorted(smenus.items())},
+                "searched_sketches": sorted(searched_sketches),
+                "n_candidates_synth": n_cand_synth,
+                "chosen": {b: f"{v['sketch']}.c{v['chunks']}"
+                           for b, v in sorted(schosen.items())},
+                "est_comm_us": round(sest_total, 3),
+                "measured_hidden_us": None,
+                "verified": bool(integrity and integrity.get("verified")),
+            }
+            if not smenus:
+                synth_block["note"] = (
+                    "workload offers no synthesized-collective menus "
+                    "(--synth-collectives is a no-op for it)")
+            elif all(len(m.get("menu", [])) <= 1 for m in smenus.values()):
+                synth_block["note"] = (
+                    "roofline pruned every sketch instantiation: no "
+                    "decomposition whose alpha-beta estimate beats the "
+                    "fixed engine's one-post floor on this "
+                    "workload/hardware (bench/roofline.py::prune_sketches)")
+            else:
+                synth_block["note"] = "; ".join(
+                    f"{b}: {m.get('note', '')}"
+                    for b, m in sorted(smenus.items()))
+            if schosen and not resilient.degraded:
+                from tenzing_tpu.obs import attrib as _attrib
+
+                t0 = time.time()
+                if profiled_attrib is not None:
+                    at_s = profiled_attrib
+                else:
+                    tl_s = _attrib.stepped_timeline(
+                        ex, reported_seq, repeats=args.profile_repeats)
+                    at_s = _attrib.analyze(reported_seq.vector(), tl_s,
+                                           measured_us=value_us)
+                smeasured = synth_hidden_comm_measured_us(
+                    reported_seq.vector(), at_s)
+                synth_block["measured_hidden_us"] = round(smeasured, 2)
+                sys.stderr.write(
+                    "synth: winner uses %s; est comm %.1fus / hidden "
+                    "measured %.1fus (wall %.0fs)\n"
+                    % (synth_block["chosen"], sest_total, smeasured,
+                       time.time() - t0))
+            else:
+                sys.stderr.write(
+                    "synth: %d menu(s), %d synthesized candidate(s) "
+                    "searched, winner fixed-engine\n"
+                    % (len(smenus), n_cand_synth))
+        except Exception as e:
+            sys.stderr.write(
+                f"synth provenance failed ({type(e).__name__}: "
+                f"{str(e)[:200]})\n")
+            synth_block = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+
     if args.dump_csv:
         # One row per distinct schedule.  The decorrelated final-batch results
         # *supersede* the search-time measurements for naive and the finalists
@@ -2135,6 +2236,10 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
     # op-chunking provenance (ISSUE 10) — present iff --chunk
     if chunked_block is not None:
         perf["chunked"] = chunked_block
+    # synthesized-collective provenance (ISSUE 17) — present iff
+    # --synth-collectives
+    if synth_block is not None:
+        perf["synth"] = synth_block
     # regime metadata (VERDICT r4 item 6): cross-round vs_baseline
     # comparisons need the chip regime (naive_us), the measurement floors
     # that produced the verdict, and the warm-start provenance — without

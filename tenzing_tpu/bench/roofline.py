@@ -247,6 +247,52 @@ def chunk_menu(counts, cost: Cost, comm_us=None, combine_bytes: float = 0.0,
     return pruned, est
 
 
+def prune_sketches(cands: Dict[str, Dict], fixed_floor_us: float,
+                   overlap_us: float = 0.0,
+                   dispatch_us: float = CHUNK_DISPATCH_US):
+    """Sketch instantiations of a synthesized collective
+    (collectives/synth.py) that could possibly beat the FIXED collective,
+    from the priced candidates ``cands`` — the synth twin of
+    :func:`prune_chunkings`, closing the same TACCL-style tractability
+    loop: the solvers only ever search instantiations the analytic model
+    cannot already rule out.
+
+    ``cands`` maps a label (``"ring.c2"``) to its alpha-beta census:
+    ``est_us`` (the serial wire cost over the topology links), ``steps``
+    (separately posted transfers) and ``chunks``.  ``fixed_floor_us`` is
+    the fixed engine's one-post alpha-beta floor for the same payload;
+    ``overlap_us`` the neighboring compute a pipelined decomposition
+    could hide transfers under (the GC3 credit — 0 when the caller models
+    no neighbor).
+
+    The rule, mirroring ``prune_chunkings``' added-cost-vs-hidden-comm
+    test: each extra post beyond the fixed engine's single one pays a
+    dispatch (``steps - 1`` extra), and chunk routing earns back at most
+    ``min(overlap_us, est_us * (k-1)/k)`` — a ``k``-chunk pipeline can
+    hide all but its head chunk's wire time, and hiding more compute
+    than exists is impossible.  An instantiation survives iff its
+    effective cost still beats ``fixed_floor_us``.
+
+    Returns ``(kept labels, {label: non-empty prune reason})``.
+    """
+    kept, pruned = [], {}
+    for label, c in cands.items():
+        est = float(c.get("est_us", 0.0))
+        steps = max(1, int(c.get("steps", 1)))
+        k = max(1, int(c.get("chunks", 1)))
+        credit = min(float(overlap_us), est * (k - 1) / k)
+        eff = est + (steps - 1) * float(dispatch_us) - credit
+        if eff < float(fixed_floor_us):
+            kept.append(label)
+        else:
+            pruned[label] = (
+                f"effective {eff:.1f}us (wire {est:.1f} + "
+                f"{steps - 1} extra dispatch @ {dispatch_us:.0f} - "
+                f"overlap credit {credit:.1f}) cannot beat the fixed "
+                f"one-post floor {float(fixed_floor_us):.1f}us")
+    return kept, pruned
+
+
 def spmv_cost(m: int, nnz: int, bytes_per_el: int = 4) -> Cost:
     """CSR y = A x: 2 FLOPs per stored element; HBM reads vals + cols +
     gathered x per stored element, plus per row one y write and one 4-byte

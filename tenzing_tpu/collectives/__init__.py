@@ -1,0 +1,47 @@
+"""Synthesized collectives: searchable chunk-routed p2p decompositions.
+
+The decision space used to pick *which* fixed comm engine runs a collective
+(XLA psum vs Pallas RDMA, etc.).  This subsystem decomposes the collectives
+THEMSELVES — all-gather / reduce-scatter / all-reduce / all-to-all — into
+chunked point-to-point steps over the actual ICI/PCIE topology
+(:mod:`~tenzing_tpu.collectives.topology`) and exposes each decomposition as
+an ordinary choice-graph alternative (:mod:`~tenzing_tpu.collectives.synth`)
+that MCTS, DFS and hill-climb search with zero solver changes.
+
+TACCL-style sketches (PAPERS.md) keep the routing space tractable: only a
+few named algorithm shapes (ring, recursive halving/doubling, chunked
+neighbor-exchange, staged host pipeline) are ever instantiated, each per
+(collective, mesh axis, chunk count, rotation), and a GC3-style alpha-beta
+cost per instantiation feeds ``bench/roofline.py::prune_sketches`` so
+instantiations that cannot beat the fixed collective's floor never enter the
+menus.  PR 10's ``ChunkedOp`` is the template throughout: a synthesized
+collective is "chunking for comm ops" — a directive + real transfer steps +
+local-combine RMW partials, certified by the verifier as-is.
+"""
+
+from tenzing_tpu.collectives.synth import (  # noqa: F401
+    SKETCHES,
+    SYNTH_MARK,
+    FixedCollective,
+    SynthCollectiveChoice,
+    SynthCollectiveOp,
+    SynthDirective,
+    SynthPlan,
+    plan_host_pipe,
+    plan_neighbor_shift,
+    plan_rhd_all_reduce,
+    plan_ring_all_reduce,
+    plan_ring_all_to_all,
+    sketch_menu,
+    synth_hidden_comm_measured_us,
+    synth_menus,
+    synths_of,
+)
+from tenzing_tpu.collectives.topology import (  # noqa: F401
+    Link,
+    Topology,
+    engine_of_kind,
+    host_topology,
+    mesh_topology,
+    ring_topology,
+)

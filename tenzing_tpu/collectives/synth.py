@@ -1,0 +1,820 @@
+"""TACCL-style sketch synthesis: collectives as searchable p2p decompositions.
+
+The decision space used to pick *which* fixed engine runs a collective
+(``PsumStart`` vs RDMA, XLA permute vs Pallas).  This module decomposes the
+collective ITSELF: a sketch (ring, reverse ring, recursive halving/doubling,
+chunked neighbor-exchange, staged host pipeline) instantiated per
+(collective, mesh axis, chunk count, rotation) becomes a
+:class:`SynthCollectiveOp` — an ordinary ``CompoundOp`` whose sub-graph is a
+chain of REAL point-to-point transfer steps (``PermuteStart`` hops over ICI,
+``HostSpillStart``/``HostFetchStart`` over PCIE) plus local-combine RMW
+partials (``AddInto``/``PlaceSlice``), each step carrying true data deps.
+PR 10's ``ChunkedOp`` is the template decision-for-decision: directive entry
+vertex, serial per-chunk chains, combine folded into accumulating updates,
+certified by the PR 4 verifier as-is and searched by MCTS/DFS/hill-climb
+through the ordinary ``ChooseOp`` machinery with ZERO solver changes.
+
+Sketches (the TACCL tractability constraint — only these shapes are ever
+instantiated):
+
+* ``ring`` / ``ringr`` — all-reduce: each chunk's accumulator circulates the
+  axis ring (forward / reverse rotation), adding the rotating partial each
+  hop; ``n-1`` hops of ``B/k`` bytes per chunk.
+* ``rhd`` — recursive halving/doubling all-reduce (power-of-two axes): the
+  accumulator itself permutes by doubling shifts ``1, 2, 4, ...`` —
+  ``log2(n)`` hops of ``B`` bytes, the latency-optimal tree shape.
+* ``neighbor`` — chunked neighbor-exchange (halo shifts): the face payload
+  splits into ``k`` chunk transfers whose awaits interleave.
+* ``pipe`` — staged host pipeline (PCIE): the payload round-trips
+  device->host->device in ``k`` chunks so fetch ``j`` overlaps spill
+  ``j+1`` — chunk routing over the host link.
+
+Every instantiation is priced by a GC3-style alpha-beta walk over the
+explicit :mod:`~tenzing_tpu.collectives.topology` links and pruned against
+the fixed collective's floor (``bench/roofline.py::prune_sketches``) before
+it ever enters a menu.  Numerics follow the chunking contract
+(docs/performance.md): pure-movement instantiations (``pipe``/``neighbor``,
+any ``k``) are bit-identical; synthesized reductions re-associate the sum
+and are held to the driver's allclose integrity gate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence as Seq, Tuple
+
+from tenzing_tpu.collectives.topology import Topology
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.operation import (
+    ChoiceOp,
+    CompoundOp,
+    CpuOp,
+    DeviceOp,
+    OpBase,
+    register_kind,
+)
+from tenzing_tpu.ops.comm_ops import (
+    AwaitTransfer,
+    HostFetchStart,
+    HostSpillStart,
+    PermuteStart,
+)
+
+# the directive marker: a SynthDirective is named
+# f"{base}{SYNTH_MARK}{sketch}.c{k}".  learn/features.py duplicates this
+# string and the sketch tuple (importing nothing from here so the featurizer
+# stays jax-free); tests/test_collectives.py asserts they agree.
+SYNTH_MARK = ".synth."
+
+#: The sketch vocabulary — the TACCL-style constraint that keeps the space
+#: tractable: nothing outside this tuple is ever instantiated.
+SKETCHES = ("ring", "ringr", "rhd", "neighbor", "pipe")
+
+#: What each sketch decomposes, for provenance blocks.
+COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+               "shift", "exchange")
+
+
+@register_kind("synth")
+class SynthDirective(CpuOp):
+    """The executed synthesis directive: a no-op host op named
+    ``<base>.synth.<sketch>.c<K>`` riding the schedule so the chosen sketch
+    and chunk count are readable from the executed op list — the synth twin
+    of ``ChunkDirective`` (``<base>.chunk.cN``) and ``fuse_tile.tN``."""
+
+    def __init__(self, base: str, sketch: str, chunks: int):
+        if sketch not in SKETCHES:
+            raise ValueError(f"unknown sketch {sketch!r} (have {SKETCHES})")
+        super().__init__(f"{base}{SYNTH_MARK}{sketch}.c{int(chunks)}")
+        self._base = base
+        self._sketch = sketch
+        self._chunks = int(chunks)
+
+    def base(self) -> str:
+        return self._base
+
+    def sketch(self) -> str:
+        return self._sketch
+
+    def chunks(self) -> int:
+        return self._chunks
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "name": self.name(), "base": self._base,
+                "sketch": self._sketch, "chunks": self._chunks}
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "SynthDirective":
+        return cls(j["base"], j["sketch"], int(j["chunks"]))
+
+
+# ---------------------------------------------------------------------------
+# step ops: the local halves of a p2p decomposition.  All compute row
+# extents from the RUNTIME shape (the TpLayerRowsPartial discipline) so the
+# same graph traces correctly under dp-sharded layouts.
+# ---------------------------------------------------------------------------
+
+
+class SlicePick(DeviceOp):
+    """``dst = src[chunk j of k]`` along axis 0 — the chunk extraction that
+    feeds a p2p hop.  ``k=1`` is a whole-buffer copy (pure movement)."""
+
+    def __init__(self, name: str, src: str, dst: str, part: int, n_parts: int):
+        super().__init__(name)
+        self._src, self._dst = src, dst
+        self._part, self._n = int(part), int(n_parts)
+
+    def reads(self) -> List[str]:
+        return [self._src]
+
+    def writes(self) -> List[str]:
+        return [self._dst]
+
+    def apply(self, bufs, ctx):
+        from jax import lax
+
+        x = bufs[self._src]
+        rows = x.shape[0]
+        if rows % self._n:
+            raise ValueError(
+                f"{self.name()}: {rows} runtime rows do not split {self._n} ways")
+        sz = rows // self._n
+        return {self._dst: lax.dynamic_slice_in_dim(x, self._part * sz, sz, 0)}
+
+
+class PlaceSlice(DeviceOp):
+    """RMW ``dst[chunk j of k] = piece`` along axis 0 — the combine fold:
+    each chain deposits its finished chunk into the collective's output
+    buffer by an accumulating slice update (disjoint slices, any order)."""
+
+    def __init__(self, name: str, piece: str, dst: str, part: int, n_parts: int):
+        super().__init__(name)
+        self._piece, self._dst = piece, dst
+        self._part, self._n = int(part), int(n_parts)
+
+    def reads(self) -> List[str]:
+        return [self._piece, self._dst]
+
+    def writes(self) -> List[str]:
+        return [self._dst]
+
+    def apply(self, bufs, ctx):
+        from jax import lax
+
+        dst = bufs[self._dst]
+        rows = dst.shape[0]
+        if rows % self._n:
+            raise ValueError(
+                f"{self.name()}: {rows} runtime rows do not split {self._n} ways")
+        lo = self._part * (rows // self._n)
+        return {self._dst: lax.dynamic_update_slice_in_dim(
+            dst, bufs[self._piece], lo, 0)}
+
+
+class AddInto(DeviceOp):
+    """RMW ``acc += piece`` — the reduction partial every all-reduce sketch
+    folds its arriving hop into (re-associates the sum; allclose-gated)."""
+
+    def __init__(self, name: str, piece: str, acc: str):
+        super().__init__(name)
+        self._piece, self._acc = piece, acc
+
+    def reads(self) -> List[str]:
+        return [self._piece, self._acc]
+
+    def writes(self) -> List[str]:
+        return [self._acc]
+
+    def apply(self, bufs, ctx):
+        return {self._acc: bufs[self._acc] + bufs[self._piece]}
+
+
+class ConcatPieces(DeviceOp):
+    """``dst = concat(pieces, axis 0)`` — the pipe sketch's reassembly of
+    its staged chunks (pure movement: bit-identical for any k)."""
+
+    def __init__(self, name: str, pieces: Seq[str], dst: str):
+        super().__init__(name)
+        self._pieces = list(pieces)
+        self._dst = dst
+
+    def reads(self) -> List[str]:
+        return list(self._pieces)
+
+    def writes(self) -> List[str]:
+        return [self._dst]
+
+    def apply(self, bufs, ctx):
+        import jax.numpy as jnp
+
+        return {self._dst: jnp.concatenate(
+            [bufs[p] for p in self._pieces], axis=0)}
+
+
+class StaticSlice(DeviceOp):
+    """``dst = src[lo:lo+size]`` with build-time bounds — the pipe sketch's
+    chunk extraction, where uneven remainders make runtime division wrong."""
+
+    def __init__(self, name: str, src: str, dst: str, lo: int, size: int):
+        super().__init__(name)
+        self._src, self._dst = src, dst
+        self._lo, self._size = int(lo), int(size)
+
+    def reads(self) -> List[str]:
+        return [self._src]
+
+    def writes(self) -> List[str]:
+        return [self._dst]
+
+    def apply(self, bufs, ctx):
+        from jax import lax
+
+        return {self._dst: lax.dynamic_slice_in_dim(
+            bufs[self._src], self._lo, self._size, 0)}
+
+
+class RowPick(DeviceOp):
+    """``dst = src[(axis_index + off) % n]`` (one peer row, kept 3-D) — the
+    all-to-all ring's send selection: at rotation step ``s`` every shard
+    picks the row destined for the peer ``s`` hops ahead."""
+
+    def __init__(self, name: str, src: str, dst: str, off: int, axis: str):
+        super().__init__(name)
+        self._src, self._dst = src, dst
+        self._off, self._axis = int(off), axis
+
+    def reads(self) -> List[str]:
+        return [self._src]
+
+    def writes(self) -> List[str]:
+        return [self._dst]
+
+    def apply(self, bufs, ctx):
+        import jax
+        from jax import lax
+
+        n = jax.lax.axis_size(self._axis)
+        i = (lax.axis_index(self._axis) + self._off) % n
+        return {self._dst: lax.dynamic_slice_in_dim(bufs[self._src], i, 1, 0)}
+
+
+class RowPlace(DeviceOp):
+    """RMW ``dst[(axis_index + off) % n] = piece`` — the all-to-all ring's
+    receive deposit: the row that arrived from ``-off`` hops back lands at
+    its sender's index (disjoint rows across steps, any order)."""
+
+    def __init__(self, name: str, piece: str, dst: str, off: int, axis: str):
+        super().__init__(name)
+        self._piece, self._dst = piece, dst
+        self._off, self._axis = int(off), axis
+
+    def reads(self) -> List[str]:
+        return [self._piece, self._dst]
+
+    def writes(self) -> List[str]:
+        return [self._dst]
+
+    def apply(self, bufs, ctx):
+        import jax
+        from jax import lax
+
+        n = jax.lax.axis_size(self._axis)
+        i = (lax.axis_index(self._axis) + self._off) % n
+        return {self._dst: lax.dynamic_update_slice_in_dim(
+            bufs[self._dst], bufs[self._piece], i, 0)}
+
+
+# ---------------------------------------------------------------------------
+# plans: one instantiated sketch = chains of real steps + staging decls +
+# an alpha-beta transfer census.  The plan is the single source of truth
+# consumed by BOTH the graph builder (op chains) and the model's buffer
+# builder (staging decls), so names and shapes cannot drift apart.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BufDecl:
+    """One staging buffer a plan needs: per-shard shape; ``space="host"``
+    decls must be placed pinned-host by the model's buffer builder."""
+
+    name: str
+    shape: Tuple[int, ...]
+    space: str = "device"
+
+
+@dataclass
+class SynthPlan:
+    """One (collective, sketch, chunk count, rotation) instantiation."""
+
+    base: str
+    collective: str
+    sketch: str
+    chunks: int
+    chains: List[List[OpBase]] = field(default_factory=list)
+    combines: List[OpBase] = field(default_factory=list)
+    buffers: List[BufDecl] = field(default_factory=list)
+    engine: str = "ici"
+    n_xfers: int = 0  # separately posted p2p transfers
+    xfer_bytes: float = 0.0  # total bytes moved across them
+
+    def label(self) -> str:
+        return f"{self.sketch}.c{self.chunks}"
+
+
+def _chunk_ranges(length: int, k: int) -> List[Tuple[int, int]]:
+    """k contiguous [lo, hi) ranges covering ``length`` (remainder spread
+    over the head chunks) — the uneven-split recipe of spmv's row part."""
+    q, r = divmod(int(length), int(k))
+    out, lo = [], 0
+    for j in range(k):
+        sz = q + (1 if j < r else 0)
+        out.append((lo, lo + sz))
+        lo += sz
+    return out
+
+
+def plan_ring_all_reduce(base: str, src: str, dst: str, axis: str,
+                         n_axis: int, part_shape: Seq[int], k: int,
+                         itemsize: int = 4,
+                         reverse: bool = False) -> SynthPlan:
+    """Chunked ring all-reduce over one ICI axis: chunk ``j``'s accumulator
+    seeds from the local slice, then ``n-1`` rotating hops each deliver a
+    peer's slice to fold in (``ringr`` rotates the other way — same cost,
+    different link direction and interleave freedom)."""
+    rows = int(part_shape[0])
+    if k < 1 or rows % k:
+        raise ValueError(f"{base}: {rows} rows do not split {k} ways")
+    if n_axis < 2:
+        raise ValueError(f"{base}: ring needs an axis extent >= 2")
+    sketch = "ringr" if reverse else "ring"
+    shift = -1 if reverse else 1
+    pre = f"{base}.{sketch}{k}"
+    cshape = (rows // k,) + tuple(int(s) for s in part_shape[1:])
+    cbytes = float(itemsize)
+    for s in cshape:
+        cbytes *= s
+    plan = SynthPlan(base, "all_reduce", sketch, k, engine="ici",
+                     n_xfers=k * (n_axis - 1),
+                     xfer_bytes=k * (n_axis - 1) * cbytes)
+    for j in range(k):
+        cur, acc = f"{pre}.x{j}.cur", f"{pre}.x{j}.acc"
+        plan.buffers += [BufDecl(cur, cshape), BufDecl(acc, cshape)]
+        chain: List[OpBase] = [
+            SlicePick(f"{pre}.x{j}.pick", src, cur, j, k),
+            SlicePick(f"{pre}.x{j}.seed", src, acc, j, k),
+        ]
+        prev = cur
+        for s in range(1, n_axis):
+            rot = f"{pre}.x{j}.rot{s}"
+            plan.buffers.append(BufDecl(rot, cshape))
+            chain += [
+                PermuteStart(f"{pre}.x{j}.p{s}", prev, rot, axis, shift),
+                AwaitTransfer(f"{pre}.x{j}.w{s}", rot),
+                AddInto(f"{pre}.x{j}.add{s}", rot, acc),
+            ]
+            prev = rot
+        chain.append(PlaceSlice(f"{pre}.x{j}.put", acc, dst, j, k))
+        plan.chains.append(chain)
+    return plan
+
+
+def plan_rhd_all_reduce(base: str, src: str, dst: str, axis: str,
+                        n_axis: int, part_shape: Seq[int],
+                        itemsize: int = 4) -> SynthPlan:
+    """Recursive halving/doubling all-reduce (power-of-two axes): the
+    accumulator itself permutes by shifts ``1, 2, 4, ...`` and folds each
+    arrival — after ``log2(n)`` hops every shard holds the full sum.  The
+    latency-optimal shape: ``log2(n)`` posts instead of the ring's
+    ``k*(n-1)``, at full payload bytes per hop."""
+    if n_axis < 2 or n_axis & (n_axis - 1):
+        raise ValueError(f"{base}: rhd needs a power-of-two axis, got {n_axis}")
+    pre = f"{base}.rhd1"
+    cshape = tuple(int(s) for s in part_shape)
+    cbytes = float(itemsize)
+    for s in cshape:
+        cbytes *= s
+    import math
+
+    hops = int(math.log2(n_axis))
+    plan = SynthPlan(base, "all_reduce", "rhd", 1, engine="ici",
+                     n_xfers=hops, xfer_bytes=hops * cbytes)
+    acc = f"{pre}.acc"
+    plan.buffers.append(BufDecl(acc, cshape))
+    chain: List[OpBase] = [SlicePick(f"{pre}.seed", src, acc, 0, 1)]
+    s = 1
+    while s < n_axis:
+        rot = f"{pre}.rot{s}"
+        plan.buffers.append(BufDecl(rot, cshape))
+        chain += [
+            PermuteStart(f"{pre}.p{s}", acc, rot, axis, s),
+            AwaitTransfer(f"{pre}.w{s}", rot),
+            AddInto(f"{pre}.add{s}", rot, acc),
+        ]
+        s *= 2
+    chain.append(PlaceSlice(f"{pre}.put", acc, dst, 0, 1))
+    plan.chains.append(chain)
+    return plan
+
+
+def plan_ring_all_to_all(base: str, src: str, dst: str, axis: str,
+                         n_axis: int, row_shape: Seq[int],
+                         itemsize: int = 4) -> SynthPlan:
+    """Ring all-to-all over one ICI axis: rotation step ``s`` picks the row
+    destined ``s`` hops ahead, permutes it there in one hop, and deposits
+    it at the sender's index — ``n-1`` single-hop posts replace the fused
+    ``AllToAllStart``, and their awaits interleave with other work.  Pure
+    movement (bit-identical): matches ``lax.all_to_all`` row-for-row."""
+    if n_axis < 2:
+        raise ValueError(f"{base}: a2a ring needs an axis extent >= 2")
+    pre = f"{base}.ring1"
+    rshape = (1,) + tuple(int(s) for s in row_shape)
+    rbytes = float(itemsize)
+    for s in rshape:
+        rbytes *= s
+    plan = SynthPlan(base, "all_to_all", "ring", 1, engine="ici",
+                     n_xfers=n_axis - 1, xfer_bytes=(n_axis - 1) * rbytes)
+    own = f"{pre}.x0.row"
+    plan.buffers.append(BufDecl(own, rshape))
+    plan.chains.append([
+        RowPick(f"{pre}.x0.pick", src, own, 0, axis),
+        RowPlace(f"{pre}.x0.put", own, dst, 0, axis),
+    ])
+    for s in range(1, n_axis):
+        row, mv = f"{pre}.x{s}.row", f"{pre}.x{s}.mv"
+        plan.buffers += [BufDecl(row, rshape), BufDecl(mv, rshape)]
+        plan.chains.append([
+            RowPick(f"{pre}.x{s}.pick", src, row, s, axis),
+            PermuteStart(f"{pre}.x{s}.p", row, mv, axis, s),
+            AwaitTransfer(f"{pre}.x{s}.w", mv),
+            RowPlace(f"{pre}.x{s}.put", mv, dst, -s, axis),
+        ])
+    return plan
+
+
+def plan_neighbor_shift(base: str, src: str, dst: str, axis: str,
+                        shift: int, part_shape: Seq[int], k: int,
+                        itemsize: int = 4) -> SynthPlan:
+    """Chunked neighbor-exchange (the halo shift): the face payload splits
+    into ``k`` chunk permutes whose awaits interleave — chunk routing for a
+    single-hop shift.  Pure movement (bit-identical for any k)."""
+    rows = int(part_shape[0])
+    if k < 1 or rows % k:
+        raise ValueError(f"{base}: {rows} rows do not split {k} ways")
+    pre = f"{base}.neighbor{k}"
+    cshape = (rows // k,) + tuple(int(s) for s in part_shape[1:])
+    cbytes = float(itemsize)
+    for s in cshape:
+        cbytes *= s
+    plan = SynthPlan(base, "shift", "neighbor", k, engine="ici",
+                     n_xfers=k, xfer_bytes=k * cbytes)
+    for j in range(k):
+        snd, mv = f"{pre}.x{j}.snd", f"{pre}.x{j}.mv"
+        plan.buffers += [BufDecl(snd, cshape), BufDecl(mv, cshape)]
+        plan.chains.append([
+            SlicePick(f"{pre}.x{j}.pick", src, snd, j, k),
+            PermuteStart(f"{pre}.x{j}.p", snd, mv, axis, shift),
+            AwaitTransfer(f"{pre}.x{j}.w", mv),
+            PlaceSlice(f"{pre}.x{j}.put", mv, dst, j, k),
+        ])
+    return plan
+
+
+def plan_host_pipe(base: str, src: str, dst: str, length: int, k: int,
+                   itemsize: int = 4) -> SynthPlan:
+    """Staged host pipeline over the PCIE link: the payload round-trips
+    device->host->device in ``k`` chunks (uneven remainders spread over the
+    head chunks), so chunk ``j``'s fetch overlaps chunk ``j+1``'s spill —
+    the exact staging discipline chunk routing buys on the host link.
+    Pure movement (bit-identical for any k); reassembled by one concat."""
+    if k < 1 or k > max(1, int(length)):
+        raise ValueError(f"{base}: cannot pipe {length} rows in {k} chunks")
+    pre = f"{base}.pipe{k}"
+    plan = SynthPlan(base, "exchange", "pipe", k, engine="pcie",
+                     n_xfers=2 * k, xfer_bytes=2.0 * length * itemsize)
+    pieces: List[str] = []
+    for j, (lo, hi) in enumerate(_chunk_ranges(length, k)):
+        snd, hst, rcv = f"{pre}.x{j}.snd", f"{pre}.x{j}.hst", f"{pre}.x{j}.rcv"
+        plan.buffers += [BufDecl(snd, (hi - lo,)),
+                         BufDecl(hst, (hi - lo,), space="host"),
+                         BufDecl(rcv, (hi - lo,))]
+        plan.chains.append([
+            StaticSlice(f"{pre}.x{j}.pick", src, snd, lo, hi - lo),
+            HostSpillStart(f"{pre}.x{j}.spill", snd, hst),
+            HostFetchStart(f"{pre}.x{j}.fetch", hst, rcv),
+            AwaitTransfer(f"{pre}.x{j}.w", rcv),
+        ])
+        pieces.append(rcv)
+    plan.combines.append(ConcatPieces(f"{pre}.cat", pieces, dst))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# graph packaging: plan -> CompoundOp / ChoiceOp, the PR 10 shapes.
+# ---------------------------------------------------------------------------
+
+
+class SynthCollectiveOp(CompoundOp):
+    """One instantiated sketch as an ordinary CompoundOp: the
+    ``synth.<sketch>.c<K>`` directive fans out into the plan's per-chunk
+    chains (serial within a chain — every step reads what its predecessor
+    wrote; free across chains — the interleave the search exploits), joined
+    by the plan's combine ops.  The scheduler inlines it through
+    ``Graph.clone_but_expand`` exactly like ``ChunkedOp``; ``est_us``
+    carries the alpha-beta estimate into ``perf.synth``."""
+
+    def __init__(self, plan: SynthPlan, est_us: Optional[float] = None):
+        super().__init__(f"{plan.base}.synthed.{plan.sketch}.c{plan.chunks}")
+        self._plan = plan
+        self.est_us = est_us
+
+    def plan(self) -> SynthPlan:
+        return self._plan
+
+    def base(self) -> str:
+        return self._plan.base
+
+    def sketch(self) -> str:
+        return self._plan.sketch
+
+    def chunks(self) -> int:
+        return self._plan.chunks
+
+    def graph(self) -> Graph:
+        p = self._plan
+        g = Graph()
+        d = SynthDirective(p.base, p.sketch, p.chunks)
+        g.start_then(d)
+        tails: List[OpBase] = []
+        for chain in p.chains:
+            prev: OpBase = d
+            for op in chain:
+                g.then(prev, op)
+                prev = op
+            tails.append(prev)
+        if p.combines:
+            prev_c: Optional[OpBase] = None
+            for cop in p.combines:
+                for t in tails:
+                    g.then(t, cop)
+                if prev_c is not None:
+                    g.then(prev_c, cop)
+                prev_c = cop
+            g.then_finish(prev_c)
+        else:
+            for t in tails:
+                g.then_finish(t)
+        return g
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"kind": self.KIND, "name": self.name(),
+                "base": self._plan.base, "sketch": self._plan.sketch,
+                "chunks": self._plan.chunks}
+
+
+class FixedCollective(CompoundOp):
+    """The fixed-engine alternative packaged for a
+    :class:`SynthCollectiveChoice`: the site's existing op chain (e.g.
+    ``PsumStart -> AwaitTransfer``), serial and unchanged — choosing it
+    executes exactly the ops the un-synthesized graph would, preserving
+    the bit-identity of the first-choice schedule."""
+
+    def __init__(self, base: str, ops: Seq[OpBase]):
+        super().__init__(f"{base}.fixed")
+        if not ops:
+            raise ValueError(f"{base}: FixedCollective needs at least one op")
+        self._ops = list(ops)
+
+    def ops(self) -> List[OpBase]:
+        return list(self._ops)
+
+    def graph(self) -> Graph:
+        g = Graph()
+        prev: Optional[OpBase] = None
+        for op in self._ops:
+            if prev is None:
+                g.start_then(op)
+            else:
+                g.then(prev, op)
+            prev = op
+        g.then_finish(prev)
+        return g
+
+
+class SynthCollectiveChoice(ChoiceOp):
+    """The synthesized-collective menu for a site with no pre-existing
+    engine ChoiceOp: the fixed chain vs the surviving sketch
+    instantiations, named ``<base>.synth`` so the choice vertex never
+    collides with an executed op name.  Sites that already offer an engine
+    menu (halo's ``ExchangeChoice``) append :class:`SynthCollectiveOp`
+    variants to that menu instead, so the engine menu and the synthesized
+    menu compete in ONE ``ChooseOp``."""
+
+    def __init__(self, base: str, fixed: FixedCollective,
+                 variants: Seq[SynthCollectiveOp],
+                 menu: Optional[Dict[str, Any]] = None):
+        super().__init__(base + ".synth")
+        self._fixed = fixed
+        self._variants = list(variants)
+        if menu is not None:
+            self.synth_menu = menu
+
+    def choices(self) -> List[OpBase]:
+        return [self._fixed] + list(self._variants)
+
+
+# ---------------------------------------------------------------------------
+# pricing + menus: alpha-beta cost over topology links, roofline prune,
+# provenance read-back.
+# ---------------------------------------------------------------------------
+
+
+def _engine_link(topo: Topology, engine: str):
+    for l in topo.links:
+        if l.engine == engine:
+            return l
+    return None
+
+
+def sketch_cost_us(plan: SynthPlan, topo: Topology) -> Optional[float]:
+    """GC3-style analytic cost of one instantiation: every posted transfer
+    pays its link's alpha, every byte pays the link's beta — a serial
+    walk over the plan's transfer census (pipelining upside is the prune
+    rule's ``overlap`` credit, not baked into the estimate)."""
+    link = _engine_link(topo, plan.engine)
+    if link is None:
+        return None
+    return plan.n_xfers * link.alpha_us + plan.xfer_bytes * link.beta_us_per_byte
+
+
+def synth_menu_info(base: str, collective: str, menu: Seq[str],
+                    est_us: Dict[str, float], pruned: Dict[str, str],
+                    fixed_floor_us: Optional[float],
+                    note: str) -> Dict[str, Any]:
+    """The ``synth_menu`` attribute choice nodes carry for provenance —
+    the synth twin of ``chunking.menu_info``.  ``menu`` always leads with
+    ``"fixed"``; ``note`` is the non-empty prune explanation the driver's
+    ``perf.synth`` block surfaces."""
+    return {"base": base, "collective": collective,
+            "menu": ["fixed"] + [m for m in menu if m != "fixed"],
+            "est_us": {k: float(v) for k, v in est_us.items()},
+            "pruned": dict(pruned),
+            "fixed_floor_us": (None if fixed_floor_us is None
+                               else float(fixed_floor_us)),
+            "note": note or "no candidates priced"}
+
+
+def sketch_menu(plans: Seq[SynthPlan], topo: Topology, fixed_bytes: float,
+                overlap_us: float = 0.0, relax: bool = False,
+                collective: Optional[str] = None
+                ) -> Tuple[List[SynthCollectiveOp], Dict[str, Any]]:
+    """Price ``plans`` over ``topo`` links, prune against the fixed
+    collective's one-post floor (``roofline.prune_sketches``), and return
+    (surviving variants, ``synth_menu`` provenance dict).
+
+    ``fixed_bytes`` is the payload the fixed engine moves in one post;
+    ``overlap_us`` the neighboring compute a pipelined instantiation could
+    hide under (the GC3 credit).  ``relax=True`` (tests / toy smoke
+    shapes, the ``chunk_relax`` twin) keeps every candidate searchable but
+    still reports what the analytic rule would have dropped."""
+    from tenzing_tpu.bench import roofline
+
+    if not plans:
+        return [], synth_menu_info(
+            "", collective or "", [], {}, {}, None,
+            "no sketch instantiations apply at this site")
+    base = plans[0].base
+    coll = collective or plans[0].collective
+    est: Dict[str, float] = {}
+    cands: Dict[str, Dict[str, Any]] = {}
+    by_label: Dict[str, SynthPlan] = {}
+    for p in plans:
+        c = sketch_cost_us(p, topo)
+        if c is None:
+            continue
+        est[p.label()] = c
+        cands[p.label()] = {"est_us": c, "steps": p.n_xfers, "chunks": p.chunks}
+        by_label[p.label()] = p
+    link = _engine_link(topo, plans[0].engine)
+    fixed_floor = link.cost_us(fixed_bytes) if link is not None else 0.0
+    kept, pruned = roofline.prune_sketches(cands, fixed_floor,
+                                           overlap_us=overlap_us)
+    if relax:
+        note = (f"relax: all {len(cands)} instantiation(s) kept searchable; "
+                f"analytic prune vs the fixed floor ({fixed_floor:.1f}us) "
+                f"would keep {len(kept)} — advisory reasons in 'pruned'")
+        kept = list(cands)
+    else:
+        note = (f"{len(pruned)} of {len(cands)} instantiation(s) pruned vs "
+                f"the fixed one-post floor ({fixed_floor:.1f}us); "
+                f"{len(kept)} kept")
+    variants = [SynthCollectiveOp(by_label[lbl], est_us=est.get(lbl))
+                for lbl in kept]
+    menu = synth_menu_info(base, coll, [v.plan().label() for v in variants],
+                           est, pruned, fixed_floor, note)
+    return variants, menu
+
+
+def synths_of(order) -> Dict[str, Dict[str, Any]]:
+    """The synthesized decompositions an executed schedule carries, by
+    site base name (``{}`` for a fixed-engine schedule) — parsed from the
+    ``<base>.synth.<sketch>.c<K>`` directives, the read-back twin of
+    ``chunking.chunks_of``."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for op in order:
+        name = op.name() if hasattr(op, "name") else str(op)
+        i = name.rfind(SYNTH_MARK)
+        if i < 0:
+            continue
+        rest = name[i + len(SYNTH_MARK):]
+        sketch, sep, cpart = rest.rpartition(".c")
+        if not sep or sketch not in SKETCHES:
+            continue
+        try:
+            out[name[:i]] = {"sketch": sketch, "chunks": max(1, int(cpart))}
+        except ValueError:
+            continue
+    return out
+
+
+def synth_menus(graph: Graph) -> Dict[str, Dict[str, Any]]:
+    """Every synthesized-collective menu a choice graph offers, keyed by
+    site base name: walks vertices recursively (compound sub-graphs,
+    choice alternatives — the serdes descent) collecting the
+    ``synth_menu`` attribute, mirroring ``chunking.chunk_menus``."""
+    menus: Dict[str, Dict[str, Any]] = {}
+    seen: set = set()
+
+    def visit(op: OpBase) -> None:
+        key = id(op)
+        if key in seen:
+            return
+        seen.add(key)
+        menu = getattr(op, "synth_menu", None)
+        if isinstance(menu, dict) and menu.get("base"):
+            menus[menu["base"]] = menu
+        if isinstance(op, CompoundOp):
+            for v in op.graph().vertices():
+                visit(v)
+        if isinstance(op, ChoiceOp):
+            for c in op.choices():
+                visit(c)
+
+    for v in graph.vertices():
+        visit(v)
+    return menus
+
+
+def synth_hidden_comm_measured_us(ops, attrib) -> float:
+    """Measured hidden comm of a synthesized schedule: total Gantt-interval
+    overlap between the chosen decomposition's transfer steps and every
+    non-synth compute unit, from the attribution profiler's stepped
+    timeline — the ``perf.synth`` twin of
+    ``chunking.hidden_comm_measured_us`` (what the chunk routing actually
+    ran under neighboring compute)."""
+    from tenzing_tpu.bench.model import ICI_KINDS, PCIE_KINDS
+
+    chosen = synths_of(ops)
+    if not chosen:
+        return 0.0
+    ops = list(ops)
+    step_prefixes = tuple(
+        f"{base}.{v['sketch']}{v['chunks']}." for base, v in chosen.items())
+    comm_kinds = set(ICI_KINDS) | set(PCIE_KINDS) | {
+        "await_transfer", "multi_await"}
+
+    def op_kind(pos: int) -> str:
+        if pos >= len(ops):
+            return ""
+        op = ops[pos]
+        base = op.unbound() if hasattr(op, "unbound") else op
+        return getattr(base, "KIND", "") or ""
+
+    xfers: List[Tuple[float, float]] = []
+    compute: List[Tuple[float, float]] = []
+    for rec in attrib.timeline.records:
+        if rec.dur_us <= 0:
+            continue
+        is_step = rec.name.startswith(step_prefixes)
+        is_comm = any(op_kind(p) in comm_kinds for p in rec.positions)
+        if is_step and is_comm:
+            xfers.append((rec.start_us, rec.end_us))
+        elif not is_step and not is_comm:
+            compute.append((rec.start_us, rec.end_us))
+    total = 0.0
+    for cs, ce in xfers:
+        for ps, pe in compute:
+            total += max(0.0, min(ce, pe) - max(cs, ps))
+    return total
+
+
+__all__ = [
+    "SYNTH_MARK", "SKETCHES", "COLLECTIVES",
+    "SynthDirective", "SynthPlan", "BufDecl",
+    "SlicePick", "PlaceSlice", "AddInto", "ConcatPieces", "StaticSlice",
+    "RowPick", "RowPlace",
+    "plan_ring_all_reduce", "plan_rhd_all_reduce", "plan_ring_all_to_all",
+    "plan_neighbor_shift", "plan_host_pipe",
+    "SynthCollectiveOp", "FixedCollective", "SynthCollectiveChoice",
+    "sketch_cost_us", "sketch_menu", "synth_menu_info",
+    "synths_of", "synth_menus", "synth_hidden_comm_measured_us",
+]

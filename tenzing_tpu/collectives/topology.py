@@ -1,0 +1,166 @@
+"""Explicit link graph for synthesized collectives.
+
+``bench/model.py`` already classifies every comm-op kind into an engine
+queue (ICI vs PCIE) and carries the per-engine alpha-beta parameters in
+``ModelEnv``.  This module turns that implicit knowledge into an explicit
+topology object: named device nodes per mesh axis, directed ``Link`` edges
+(ring ICI neighbors per axis, a PCIE staging link between each device and
+its host), and per-link alpha-beta costs in microseconds.  Sketch
+instantiation (:mod:`~tenzing_tpu.collectives.synth`) walks these links to
+price every (collective, axis, chunk count, rotation) candidate before the
+roofline prune, so the menu the solvers see is derived from the same cost
+surface the analytic benchmarker charges at measurement time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from tenzing_tpu.bench.model import ICI_KINDS, PCIE_KINDS, ModelEnv
+
+#: Engine labels, matching ``bench/model.py``'s queue names.
+ENGINES = ("ici", "pcie")
+
+#: Node label for the host end of a PCIE staging link.
+HOST_NODE = "host"
+
+
+def engine_of_kind(kind: str) -> Optional[str]:
+    """Map a registered comm-op kind onto its engine queue, or ``None``."""
+    if kind in ICI_KINDS:
+        return "ici"
+    if kind in PCIE_KINDS:
+        return "pcie"
+    return None
+
+
+@dataclass(frozen=True)
+class Link:
+    """One directed point-to-point link with an alpha-beta cost model."""
+
+    src: str
+    dst: str
+    engine: str  # "ici" | "pcie"
+    alpha_us: float  # per-transfer post latency
+    beta_us_per_byte: float  # inverse bandwidth
+
+    def cost_us(self, nbytes: float) -> float:
+        return self.alpha_us + float(nbytes) * self.beta_us_per_byte
+
+
+def ici_link_params(env: Optional[ModelEnv] = None) -> Tuple[float, float]:
+    """(alpha_us, beta_us_per_byte) of one ICI hop, from ``ModelEnv``."""
+    env = env or ModelEnv()
+    return env.ici_lat * 1e6, 1e6 / env.ici_bw
+
+
+def pcie_link_params(env: Optional[ModelEnv] = None) -> Tuple[float, float]:
+    """(alpha_us, beta_us_per_byte) of the host staging path.
+
+    The analytic model charges PCIE pure bandwidth; the post latency is
+    folded into the per-op overhead, which we surface as alpha here so a
+    staged pipeline pays per-chunk dispatch like the real executor does.
+    """
+    env = env or ModelEnv()
+    return env.op_overhead * 1e6, 1e6 / env.pcie_bw
+
+
+@dataclass(frozen=True)
+class Topology:
+    """A set of directed links plus node bookkeeping."""
+
+    links: Tuple[Link, ...] = field(default_factory=tuple)
+
+    def nodes(self) -> List[str]:
+        seen: Dict[str, None] = {}
+        for l in self.links:
+            seen.setdefault(l.src)
+            seen.setdefault(l.dst)
+        return list(seen)
+
+    def out_links(self, src: str) -> List[Link]:
+        return [l for l in self.links if l.src == src]
+
+    def link(self, src: str, dst: str) -> Optional[Link]:
+        for l in self.links:
+            if l.src == src and l.dst == dst:
+                return l
+        return None
+
+    def engines(self) -> List[str]:
+        out = []
+        for l in self.links:
+            if l.engine not in out:
+                out.append(l.engine)
+        return out
+
+    def merged(self, other: "Topology") -> "Topology":
+        return Topology(self.links + other.links)
+
+    def min_hops(self, src: str, dst: str) -> int:
+        """BFS hop count between two nodes; -1 when unreachable."""
+        if src == dst:
+            return 0
+        frontier, dist = [src], {src: 0}
+        while frontier:
+            nxt = []
+            for node in frontier:
+                for l in self.out_links(node):
+                    if l.dst not in dist:
+                        dist[l.dst] = dist[node] + 1
+                        if l.dst == dst:
+                            return dist[l.dst]
+                        nxt.append(l.dst)
+            frontier = nxt
+        return -1
+
+
+def _axis_node(axis: str, i: int) -> str:
+    return f"{axis}{i}"
+
+
+def ring_topology(axis: str, n: int, env: Optional[ModelEnv] = None) -> Topology:
+    """Bidirectional ring of ICI links along one mesh axis.
+
+    TPU ICI axes are wrapped tori, so every device has a +1 and a -1
+    neighbor; both directions exist so reverse-rotation ring sketches
+    ("ringr") price identically to the forward rotation.
+    """
+    alpha, beta = ici_link_params(env)
+    links = []
+    for i in range(max(1, n)):
+        j = (i + 1) % max(1, n)
+        if j == i:
+            continue
+        links.append(Link(_axis_node(axis, i), _axis_node(axis, j), "ici", alpha, beta))
+        links.append(Link(_axis_node(axis, j), _axis_node(axis, i), "ici", alpha, beta))
+    return Topology(tuple(links))
+
+
+def host_topology(device: str = "d0", env: Optional[ModelEnv] = None) -> Topology:
+    """PCIE staging links: device -> host (spill) and host -> device (fetch)."""
+    alpha, beta = pcie_link_params(env)
+    return Topology((
+        Link(device, HOST_NODE, "pcie", alpha, beta),
+        Link(HOST_NODE, device, "pcie", alpha, beta),
+    ))
+
+
+def mesh_topology(axes: Mapping[str, int], host: bool = True,
+                  env: Optional[ModelEnv] = None) -> Topology:
+    """Union of per-axis ICI rings plus the PCIE host link.
+
+    ``axes`` mirrors the mesh signature the fingerprint already records:
+    ordered (axis name -> extent).  Multi-axis meshes contribute one ring
+    per axis; collectives synthesize along exactly one axis at a time, the
+    same restriction the fixed engines observe.
+    """
+    topo = Topology()
+    for axis, n in axes.items():
+        if n > 1:
+            topo = topo.merged(ring_topology(axis, n, env))
+    if host:
+        first = next(iter(axes), "d")
+        topo = topo.merged(host_topology(_axis_node(first, 0), env))
+    return topo

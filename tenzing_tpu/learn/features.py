@@ -63,6 +63,13 @@ _CHOICE_MARKS = (".pallas", ".xla", ".rdma", ".host", "bf16")
 # featurizer stays import-light (tests/test_chunking.py asserts agreement).
 _CHUNK_MARK = ".chunk.c"
 _TILE_PREFIX = "fuse_tile.t"
+# synthesized-collective directive marker + sketch vocabulary (ISSUE 17):
+# the executed ``<base>.synth.<sketch>.c<K>`` directives carry which p2p
+# decomposition the solver chose at each exchange site.  Duplicated from
+# collectives/synth.py::SYNTH_MARK/SKETCHES for the same import-light
+# reason (tests/test_collectives.py asserts agreement).
+_SYNTH_MARK = ".synth."
+_SYNTH_SKETCHES = ("ring", "ringr", "rhd", "neighbor", "pipe")
 
 FEATURE_NAMES: List[str] = (
     ["n_ops", "n_device", "n_host_data", "n_sync"]
@@ -77,6 +84,8 @@ FEATURE_NAMES: List[str] = (
     # load contract loudly (learn/model.py) instead of mis-predicting
     + ["n_chunk_dir", "sum_chunk_counts", "n_fuse_tile_dir",
        "sum_fuse_tiles"]
+    + [f"n_synth_{s}" for s in ("dir",) + _SYNTH_SKETCHES]
+    + ["sum_synth_chunks"]
 )
 
 
@@ -109,6 +118,8 @@ def featurize(
     choice_counts = {m: 0 for m in _CHOICE_MARKS}
     ici_bytes = pcie_bytes = 0.0
     n_chunk_dir = sum_chunks = n_tile_dir = sum_tiles = 0
+    n_synth_dir = sum_synth_chunks = 0
+    synth_sketch_counts = {s: 0 for s in _SYNTH_SKETCHES}
     for op in seq:
         kind = getattr(op, "KIND", "")
         if kind in kind_counts:
@@ -142,6 +153,20 @@ def featurize(
                 n_tile_dir += 1
             except ValueError:
                 pass
+        # synth directives (``<base>.synth.<sketch>.c<K>``): like chunk
+        # directives, count only the directive op, not the p2p steps (step
+        # names carry ``<base>.<sketch><K>.`` prefixes, not the mark)
+        j = name.rfind(_SYNTH_MARK)
+        if j >= 0:
+            sketch, sep, cpart = \
+                name[j + len(_SYNTH_MARK):].rpartition(".c")
+            if sep and sketch in synth_sketch_counts:
+                try:
+                    sum_synth_chunks += max(1, int(cpart))
+                    synth_sketch_counts[sketch] += 1
+                    n_synth_dir += 1
+                except ValueError:
+                    pass
         sz = float(sum(nbytes.get(n, 0) for n in _reads(op)))
         if kind in ICI_KINDS:
             ici_bytes += sz
@@ -160,5 +185,8 @@ def featurize(
             math.log(max(makespan, 1e-12))]
     out += [float(n_chunk_dir), float(sum_chunks),
             float(n_tile_dir), float(sum_tiles)]
+    out += [float(n_synth_dir)]
+    out += [float(synth_sketch_counts[s]) for s in _SYNTH_SKETCHES]
+    out += [float(sum_synth_chunks)]
     assert len(out) == len(FEATURE_NAMES)
     return out

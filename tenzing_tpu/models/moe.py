@@ -264,6 +264,28 @@ class ExpertFFNChoice(ChoiceOp):
         return out
 
 
+# -- synthesized all-to-all (collectives/synth.py) --------------------------
+
+
+def moe_synth_plans(args: MoEArgs, c: int, site: str, cap: int = None):
+    """Ring all-to-all instantiations for chunk ``c``'s dispatch or combine
+    exchange (``site`` in ``{"disp", "comb"}``): n-1 single-hop rotations
+    replace the fused ``AllToAllStart``, each await free to interleave.
+    ``cap`` is the capacity (slot-table width); the graph-time default
+    ``chunk_tokens`` is its upper bound (pricing only — the buffer builder
+    passes the routed capacity)."""
+    from tenzing_tpu.collectives.synth import plan_ring_all_to_all
+
+    if args.n_ep < 2:
+        return []
+    cap = int(args.chunk_tokens if cap is None else cap)
+    src = f"send_disp_{c}" if site == "disp" else f"ffn_out_{c}"
+    dst = f"recv_disp_{c}" if site == "disp" else f"recv_comb_{c}"
+    return [plan_ring_all_to_all(
+        f"a2a_{site}_{c}", src, dst, AXIS, args.n_ep,
+        (cap, args.d_model), itemsize=np.dtype(args.dtype).itemsize)]
+
+
 class CombineScatter(DeviceOp):
     """Scatter-add the returned expert outputs back into token order, scaled
     by the gate weights (padding slots have weight 0)."""
@@ -319,16 +341,23 @@ class MoELayer(CompoundOp):
     ``impl_choice`` each chunk's FFN kernel is searched; ``chunk=True``
     adds T3-style chunked expert-FFN alternatives to the menus
     (core/chunking.py; :func:`ffn_chunk_menu` prunes the counts through
-    the roofline — ``chunk_relax`` skips the pruning, the tests mode)."""
+    the roofline — ``chunk_relax`` skips the pruning, the tests mode).
+    ``synth=True`` puts synthesized ring all-to-all decompositions
+    (collectives/synth.py) next to each chunk's fused dispatch/combine
+    exchange in one ChooseOp; ``synth_relax`` keeps analytically-dominated
+    instantiations searchable."""
 
     def __init__(self, args: MoEArgs, name: str = "moe",
                  impl_choice: bool = False, chunk: bool = False,
-                 chunk_relax: bool = False):
+                 chunk_relax: bool = False, synth: bool = False,
+                 synth_relax: bool = False):
         super().__init__(name)
         self._args = args
         self._impl_choice = impl_choice
         self._chunk = chunk
         self._chunk_relax = chunk_relax
+        self._synth = synth
+        self._synth_relax = synth_relax
 
     def args(self) -> MoEArgs:
         return self._args
@@ -351,34 +380,51 @@ class MoELayer(CompoundOp):
                 return ChunkChoice(op, chunk_variants(op, counts, est))
         else:
             mk = ExpertFFN
+
+        def a2a(base, src, dst, prev, nxt):
+            start = AllToAllStart(base, src, dst, AXIS, split_axis=0)
+            await_ = AwaitTransfer(f"await_{base[4:]}", dst)
+            if self._synth and self._args.n_ep >= 2:
+                from tenzing_tpu.collectives.synth import (
+                    FixedCollective, SynthCollectiveChoice, sketch_menu)
+                from tenzing_tpu.collectives.topology import mesh_topology
+
+                a = self._args
+                cap = a.chunk_tokens  # capacity upper bound for pricing
+                bpe = np.dtype(a.dtype).itemsize
+                site = "disp" if "disp" in base else "comb"
+                variants, menu = sketch_menu(
+                    moe_synth_plans(a, c, site),
+                    mesh_topology({AXIS: a.n_ep}, host=False),
+                    fixed_bytes=float(a.n_ep * cap * a.d_model * bpe),
+                    relax=self._synth_relax, collective="all_to_all")
+                if variants:
+                    node = SynthCollectiveChoice(
+                        base, FixedCollective(base, [start, await_]),
+                        variants, menu)
+                    g.then(prev, node)
+                    g.then(node, nxt)
+                    return
+            g.then(prev, start)
+            g.then(start, await_)
+            g.then(await_, nxt)
+
         for c in range(self._args.n_chunks):
             pack = DispatchPack(f"pack_{c}", c, self._args)
-            disp = AllToAllStart(
-                f"a2a_disp_{c}", f"send_disp_{c}", f"recv_disp_{c}", AXIS,
-                split_axis=0,
-            )
-            adisp = AwaitTransfer(f"await_disp_{c}", f"recv_disp_{c}")
             ffn = mk(f"ffn_{c}", c, self._args)
-            comb = AllToAllStart(
-                f"a2a_comb_{c}", f"ffn_out_{c}", f"recv_comb_{c}", AXIS,
-                split_axis=0,
-            )
-            acomb = AwaitTransfer(f"await_comb_{c}", f"recv_comb_{c}")
             scat = CombineScatter(f"combine_{c}", c, self._args)
             g.start_then(pack)
-            g.then(pack, disp)
-            g.then(disp, adisp)
-            g.then(adisp, ffn)
-            g.then(ffn, comb)
-            g.then(comb, acomb)
-            g.then(acomb, scat)
+            a2a(f"a2a_disp_{c}", f"send_disp_{c}", f"recv_disp_{c}",
+                pack, ffn)
+            a2a(f"a2a_comb_{c}", f"ffn_out_{c}", f"recv_comb_{c}",
+                ffn, scat)
             g.then(scat, cat)
         g.then_finish(cat)
         return g
 
 
 def make_moe_buffers(
-    args: MoEArgs, seed: int = 0
+    args: MoEArgs, seed: int = 0, synth: bool = False
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
     """(buffers, partition specs, expected Y) for the EP layer on a 1-D
     ``("ep",)`` mesh.  Routing (top-1 gating) runs here, on the host, against
@@ -440,6 +486,20 @@ def make_moe_buffers(
             specs[nm] = P(AXIS, None, None)
         bufs[f"Y_{c}"] = np.zeros((n * tc_, d), dt)
         specs[f"Y_{c}"] = P(AXIS, None)
+        if synth:
+            # staging buffers for the synthesized ring all-to-all: plans
+            # price against the chunk_tokens upper bound, but allocation
+            # uses the routed capacity so runtime shapes line up
+            for site in ("disp", "comb"):
+                for plan in moe_synth_plans(args, c, site, cap=cap):
+                    for decl in plan.buffers:
+                        if decl.name in bufs:
+                            continue
+                        gshape = ((n * decl.shape[0],)
+                                  + tuple(decl.shape[1:]))
+                        bufs[decl.name] = np.zeros(gshape, dt)
+                        specs[decl.name] = P(
+                            AXIS, *([None] * (len(gshape) - 1)))
 
     # dense host reference: y[t] = gate * expert_e(x[t]) in float64
     x64 = x.astype(np.float64)

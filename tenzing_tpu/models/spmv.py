@@ -421,6 +421,32 @@ class LocalExchange(DeviceOp):
         return {self._src: 0, self._dst: 0}
 
 
+# -- synthesized exchange (collectives/synth.py) ---------------------------------
+
+#: The synth site name of the host x-exchange: the directive rides the
+#: executed schedule as ``x_exchange.synth.pipe.c<K>``.
+SPMV_SYNTH_BASE = "x_exchange"
+
+
+def spmv_synth_counts(n_remote: Optional[int]) -> List[int]:
+    """Structurally valid pipe chunk counts for an ``n_remote``-entry
+    exchange payload: 2 and 4 where they fit (k=1 staged routing IS the
+    fixed round trip — offering it would duplicate the fixed alternative).
+    Unknown payload -> no counts, never guessed."""
+    return [k for k in (2, 4) if 2 <= k <= int(n_remote or 0)]
+
+
+def spmv_synth_plans(n_remote: Optional[int]):
+    """The pipe-sketch instantiations of the host x-exchange — the single
+    source of truth for BOTH the graph's step chains and the buffer
+    builder's staging decls (same plan, same names, same shapes)."""
+    from tenzing_tpu.collectives.synth import plan_host_pipe
+
+    return [plan_host_pipe(SPMV_SYNTH_BASE, "send_buf", "x_remote",
+                           int(n_remote), k)
+            for k in spmv_synth_counts(n_remote)]
+
+
 class SpMVCompound(CompoundOp):
     """The whole SpMV iteration as one compound op (reference SpMV CompoundOp,
     ops_spmv.cuh:306-436): start -> {local spmv, scatter -> exchange}; exchange
@@ -440,18 +466,36 @@ class SpMVCompound(CompoundOp):
       (spill -> fetch -> await), the same substrate as the halo pipeline.
       This is the faithful analog of the reference's network hop: the search
       can hide the transfer behind the local SpMV, and the naive
-      serialization pays it in full."""
+      serialization pays it in full.
+
+    ``synth=True`` (requires ``exchange="host"``) additionally decomposes
+    the exchange through the synthesized-collectives subsystem
+    (collectives/synth.py): the fixed round trip becomes one alternative of
+    a :class:`~tenzing_tpu.collectives.synth.SynthCollectiveChoice` whose
+    other alternatives pipeline the payload device->host->device in k
+    chunks (the ``pipe`` sketch — pure movement, bit-identical), so the
+    solvers search the chunk routing of the exchange itself.  The remote-x
+    length must be known (``x_sizes["x_remote"]``) — an unknown payload is
+    never synthesized, the ``pow2_counts`` never-guess discipline.
+    ``synth_relax`` keeps analytically-losing instantiations searchable
+    (tests / toy smoke shapes), the ``chunk_relax`` twin."""
 
     def __init__(self, name: str = "spmv", impl_choice: bool = False,
                  x_sizes: Optional[Dict[str, int]] = None,
-                 exchange: str = "local"):
+                 exchange: str = "local", synth: bool = False,
+                 synth_relax: bool = False):
         super().__init__(name)
         self._impl_choice = impl_choice
         # buffer-name -> x length, when known (prunes unsupported Pallas choices)
         self._x_sizes = dict(x_sizes) if x_sizes else {}
         if exchange not in ("local", "host"):
             raise ValueError(f"exchange must be 'local' or 'host', got {exchange!r}")
+        if synth and exchange != "host":
+            raise ValueError("synth=True needs the exchange='host' round trip "
+                             "(the PCIE link is what the pipe sketch routes)")
         self._exchange = exchange
+        self._synth = synth
+        self._synth_relax = synth_relax
 
     def graph(self) -> Graph:
         g = Graph()
@@ -477,10 +521,34 @@ class SpMVCompound(CompoundOp):
             spill = HostSpillStart("spill_x", "send_buf", "host_x")
             fetch = HostFetchStart("fetch_x", "host_x", "x_remote")
             await_ = AwaitTransfer("await_x", "x_remote")
-            g.then(scatter, spill)
-            g.then(spill, fetch)
-            g.then(fetch, await_)
-            g.then(await_, yr)
+            variants = []
+            if self._synth:
+                from tenzing_tpu.collectives.synth import (
+                    FixedCollective,
+                    SynthCollectiveChoice,
+                    sketch_menu,
+                )
+                from tenzing_tpu.collectives.topology import host_topology
+
+                n_rem = self._x_sizes.get("x_remote")
+                variants, menu = sketch_menu(
+                    spmv_synth_plans(n_rem), host_topology(),
+                    # the fixed floor: the round trip's bytes in one
+                    # optimistic post (spill+fetch move them twice)
+                    fixed_bytes=2.0 * 4 * int(n_rem or 0),
+                    relax=self._synth_relax, collective="exchange")
+            if variants:
+                choice = SynthCollectiveChoice(
+                    SPMV_SYNTH_BASE,
+                    FixedCollective(SPMV_SYNTH_BASE, [spill, fetch, await_]),
+                    variants, menu)
+                g.then(scatter, choice)
+                g.then(choice, yr)
+            else:
+                g.then(scatter, spill)
+                g.then(spill, fetch)
+                g.then(fetch, await_)
+                g.then(await_, yr)
         else:
             exch = LocalExchange("exchange", "send_buf", "x_remote")
             g.then(scatter, exch)
@@ -498,6 +566,7 @@ def make_spmv_buffers(
     seed: int = 0,
     slab_width: Optional[int] = None,
     matrix: Optional[CsrMat] = None,
+    synth: bool = False,
 ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
     """Build the buffer dict for the single-device SpMV slice and the dense
     reference answer.  The matrix is split at the column midpoint to mimic the
@@ -538,11 +607,25 @@ def make_spmv_buffers(
         "y_remote": np.zeros(m, dtype=np.float32),
         "y": np.zeros(m, dtype=np.float32),
     }
+    if synth:
+        # staging decls of the synthesized exchange (pipe sketch): the same
+        # plans the graph builds from, so names/shapes cannot drift
+        for plan in spmv_synth_plans(len(send_idx)):
+            for d in plan.buffers:
+                bufs[d.name] = np.zeros(d.shape, dtype=np.float32)
     want = a.matvec(x)
     return bufs, want
 
 
-def spmv_host_buffer_names() -> List[str]:
+def spmv_host_buffer_names(n_remote: Optional[int] = None,
+                           synth: bool = False) -> List[str]:
     """Buffers to device_put into pinned_host for ``exchange="host"`` (the
-    executor detects host residency from the array's sharding memory kind)."""
-    return ["host_x"]
+    executor detects host residency from the array's sharding memory kind).
+    With ``synth=True`` the pipe sketch's per-chunk host staging pieces are
+    included (``n_remote`` = the exchange payload length, i.e. the
+    ``send_idx`` extent the buffers were built with)."""
+    out = ["host_x"]
+    if synth:
+        for plan in spmv_synth_plans(n_remote):
+            out += [d.name for d in plan.buffers if d.space == "host"]
+    return out

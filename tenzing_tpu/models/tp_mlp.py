@@ -170,6 +170,52 @@ def mlp_chunk_menu(args: TpMlpArgs, relax: bool = False):
         combine_bytes=2.0 * part, relax=relax)
 
 
+# -- synthesized all-reduce (collectives/synth.py) --------------------------
+#
+# Each layer's psum site can decompose into chunk-routed p2p steps over the
+# tp ring: ring reduce (k chunks), reverse-rotation ring, and recursive
+# halving/doubling — each an ordinary choice alternative next to the fixed
+# PsumStart chain, searched by the solvers with zero solver changes.
+
+
+def tp_mlp_synth_counts(args: TpMlpArgs, n_dp: int = 1) -> List[int]:
+    """Ring chunk counts that split one chunk's per-device batch rows:
+    {1, 2} filtered by divisibility — c1 is the classic ring, c2 the
+    chunk-routed variant whose two chains interleave."""
+    rows = args.mb_size // max(1, n_dp)
+    return [k for k in (1, 2) if 1 <= k <= rows and rows % k == 0]
+
+
+def tp_mlp_synth_plans(args: TpMlpArgs, c: int, layer: int, n_dp: int = 1):
+    """All sketch instantiations of layer ``layer``'s all-reduce for chunk
+    ``c``: ring.c{k} forward rotations, ringr.c1 reverse, and rhd.c1 when
+    the tp extent is a power of two.  Shapes are per-device (the runtime
+    view inside shard_map): ``mb_size // n_dp`` rows of ``d_model``."""
+    from tenzing_tpu.collectives.synth import (
+        plan_rhd_all_reduce,
+        plan_ring_all_reduce,
+    )
+
+    if args.n_tp < 2:
+        return []
+    rows = args.mb_size // max(1, n_dp)
+    shape = (rows, args.d_model)
+    bpe = int(np.dtype(args.dtype).itemsize)
+    base = f"psum_{c}_{layer}"
+    src, dst = f"part_{c}_{layer}", f"sum_{c}_{layer}"
+    plans = [
+        plan_ring_all_reduce(base, src, dst, AXIS, args.n_tp, shape, k,
+                             itemsize=bpe)
+        for k in tp_mlp_synth_counts(args, n_dp)
+    ]
+    plans.append(plan_ring_all_reduce(base, src, dst, AXIS, args.n_tp, shape,
+                                      1, itemsize=bpe, reverse=True))
+    if args.n_tp & (args.n_tp - 1) == 0:
+        plans.append(plan_rhd_all_reduce(base, src, dst, AXIS, args.n_tp,
+                                         shape, itemsize=bpe))
+    return plans
+
+
 class ConcatOut(DeviceOp):
     """Stack the chunks' final reduced outputs back into batch order."""
 
@@ -205,14 +251,29 @@ class TpMlp(CompoundOp):
     :class:`~tenzing_tpu.core.chunking.ChunkChoice` so the solvers search
     T3-style batch-row splits whose tail partials the psum post overlaps
     (core/chunking.py; :func:`mlp_chunk_menu` prunes the counts through
-    the roofline — ``chunk_relax`` skips the pruning, the tests mode)."""
+    the roofline — ``chunk_relax`` skips the pruning, the tests mode).
+
+    ``synth=True`` additionally wraps each layer's all-reduce in a
+    :class:`~tenzing_tpu.collectives.synth.SynthCollectiveChoice`: the
+    fixed ``PsumStart -> AwaitTransfer`` chain competes against ring /
+    reverse-ring / recursive-halving-doubling decompositions synthesized
+    over the tp ring topology (:func:`tp_mlp_synth_plans`), priced per
+    link and pruned against the psum's one-post floor.  ``synth_relax``
+    keeps analytically-losing instantiations searchable (tests / toy
+    shapes); ``synth_dp`` is the dp extent the runtime shards batch rows
+    over, so chunk counts validate against the true per-device rows."""
 
     def __init__(self, args: TpMlpArgs, name: str = "tp_mlp",
-                 chunk: bool = False, chunk_relax: bool = False):
+                 chunk: bool = False, chunk_relax: bool = False,
+                 synth: bool = False, synth_relax: bool = False,
+                 synth_dp: int = 1):
         super().__init__(name)
         self._args = args
         self._chunk = chunk
         self._chunk_relax = chunk_relax
+        self._synth = synth
+        self._synth_relax = synth_relax
+        self._synth_dp = max(1, synth_dp)
 
     def args(self) -> TpMlpArgs:
         return self._args
@@ -249,16 +310,42 @@ class TpMlp(CompoundOp):
                     g.start_then(mlp)
                 else:
                     g.then(prev, mlp)
-                g.then(mlp, post)
-                g.then(post, await_)
-                prev = await_
+                variants = []
+                if self._synth:
+                    from tenzing_tpu.collectives.synth import (
+                        FixedCollective,
+                        SynthCollectiveChoice,
+                        sketch_menu,
+                    )
+                    from tenzing_tpu.collectives.topology import mesh_topology
+
+                    bpe = np.dtype(a.dtype).itemsize
+                    part_bytes = (a.mb_size // self._synth_dp) * a.d_model * bpe
+                    variants, menu = sketch_menu(
+                        tp_mlp_synth_plans(a, c, l, n_dp=self._synth_dp),
+                        mesh_topology({AXIS: a.n_tp}, host=False),
+                        # the psum floor: a ring all-reduce moves ~2x the
+                        # partial bytes in one fused post
+                        fixed_bytes=2.0 * part_bytes,
+                        relax=self._synth_relax, collective="all_reduce")
+                if variants:
+                    choice = SynthCollectiveChoice(
+                        f"psum_{c}_{l}",
+                        FixedCollective(f"psum_{c}_{l}", [post, await_]),
+                        variants, menu)
+                    g.then(mlp, choice)
+                    prev = choice
+                else:
+                    g.then(mlp, post)
+                    g.then(post, await_)
+                    prev = await_
             g.then(prev, cat)
         g.then_finish(cat)
         return g
 
 
 def make_tp_mlp_buffers(
-    args: TpMlpArgs, seed: int = 0, n_dp: int = 1
+    args: TpMlpArgs, seed: int = 0, n_dp: int = 1, synth: bool = False
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, object], np.ndarray]:
     """(buffers, partition specs, expected Y) for the TP forward.  W1 is
     column-sharded, W2 row-sharded (Megatron layout); chunk inputs are
@@ -314,6 +401,18 @@ def make_tp_mlp_buffers(
             specs[f"part_{c}_{l}"] = P((AXIS,) + dp, None)
             bufs[f"sum_{c}_{l}"] = np.zeros((args.n_tp * b, d), dt)
             specs[f"sum_{c}_{l}"] = P((AXIS,) + dp, None)
+            if synth:
+                # staging decls of the synthesized all-reduce sketches: the
+                # plans carry per-device shapes; globals shard-stack them
+                # over (tp, dp) like every other written activation
+                for plan in tp_mlp_synth_plans(args, c, l, n_dp=n_dp):
+                    for decl in plan.buffers:
+                        if decl.name in bufs:
+                            continue
+                        gshape = ((args.n_tp * n_dp * decl.shape[0],)
+                                  + tuple(decl.shape[1:]))
+                        bufs[decl.name] = np.zeros(gshape, dt)
+                        specs[decl.name] = P((AXIS,) + dp, None)
     # expected Y in the device layout: under P(("tp","dp")) each (tp, dp)
     # shard holds one contiguous global block containing ITS dp-slice of
     # every chunk in chunk order — so per tp copy, rows group dp-major

@@ -336,6 +336,7 @@ def bench_section(paths: List[str]) -> List[str]:
              "|---|---|---|---|---|---|---|---|---|---|---|"]
     fused_lines: List[str] = []
     chunk_lines: List[str] = []
+    synth_lines: List[str] = []
     for path in paths:
         try:
             d = load_driver_json(path)
@@ -386,6 +387,32 @@ def bench_section(paths: List[str]) -> List[str]:
                     f"hidden comm est {hc.get('estimated', 0)}us / "
                     f"measured {'—' if msd is None else f'{msd}us'}"
                     + (f" — {ch['note']}" if ch.get("note") else ""))
+        sy = perf.get("synth")
+        if sy:
+            # synthesized-collective economics (docs/performance.md,
+            # "Synthesized collectives"): the sketch menus the pricing let
+            # stand next to the fixed engine, what the search visited and
+            # chose, and the est-vs-measured comm of the decomposition
+            if "error" in sy and "menus" not in sy:
+                synth_lines.append(
+                    f"- `{os.path.basename(path)}`: synth provenance "
+                    f"failed ({sy['error']})")
+            else:
+                smenus = sy.get("menus") or {}
+                n_alt = sum(1 for m in smenus.values()
+                            if len(m.get("menu", [])) > 1)
+                schosen = sy.get("chosen") or {}
+                msd = sy.get("measured_hidden_us")
+                synth_lines.append(
+                    f"- `{os.path.basename(path)}`: {len(smenus)} site(s) "
+                    f"({n_alt} with sketch alternatives), searched "
+                    f"{sy.get('searched_sketches', [])} over "
+                    f"{sy.get('n_candidates_synth', 0)} candidate(s), "
+                    f"winner {'fixed-engine' if not schosen else schosen}, "
+                    f"est comm {sy.get('est_comm_us', 0)}us / hidden "
+                    f"measured {'—' if msd is None else f'{msd}us'}, "
+                    f"verified {sy.get('verified', False)}"
+                    + (f" — {sy['note']}" if sy.get("note") else ""))
         fu = perf.get("fused")
         if fu:
             # megakernel-fusion economics (docs/performance.md): regions
@@ -413,6 +440,8 @@ def bench_section(paths: List[str]) -> List[str]:
         lines += ["### Megakernel fusion", ""] + fused_lines + [""]
     if chunk_lines:
         lines += ["### Chunked overlap", ""] + chunk_lines + [""]
+    if synth_lines:
+        lines += ["### Synthesized collectives", ""] + synth_lines + [""]
     return lines
 
 

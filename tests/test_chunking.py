@@ -640,13 +640,18 @@ class TestFeatureMarkers:
         assert v["sum_fuse_tiles"] == 8.0
 
     def test_feature_names_append_only(self):
-        """The four directive coordinates sit at the END of the vector:
-        every pre-existing coordinate keeps its position, so corpora
-        featurized before the append stay consistent."""
+        """Directive coordinates sit at the END of the vector in append
+        order (chunk/tile four, then the synth seven): every pre-existing
+        coordinate keeps its position, so corpora featurized before an
+        append stay consistent."""
         from tenzing_tpu.learn.features import FEATURE_NAMES
 
-        assert FEATURE_NAMES[-4:] == ["n_chunk_dir", "sum_chunk_counts",
-                                      "n_fuse_tile_dir", "sum_fuse_tiles"]
+        assert FEATURE_NAMES[-11:-7] == ["n_chunk_dir", "sum_chunk_counts",
+                                         "n_fuse_tile_dir", "sum_fuse_tiles"]
+        assert FEATURE_NAMES[-7:] == ["n_synth_dir", "n_synth_ring",
+                                      "n_synth_ringr", "n_synth_rhd",
+                                      "n_synth_neighbor", "n_synth_pipe",
+                                      "sum_synth_chunks"]
         assert FEATURE_NAMES.index("n_ops") == 0  # prefix unchanged
 
     def test_save_load_contract_rejects_pre_append_model(self, tmp_path):

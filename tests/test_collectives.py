@@ -1,0 +1,630 @@
+"""Synthesized collectives (ISSUE 17): topology pricing, sketch protocol,
+roofline pruning goldens, step-op algebra, graph-level soundness of every
+opted-in model, verifier fuzz over synthesized projections, solver
+enumeration of sketch alternatives, and the directive feature markers.
+
+The acceptance gates:
+
+* **soundness**: every synthesized projection the synthesizer emits over the
+  sketch-extended choice graphs passes the independent PR-4 verifier
+  (0 false positives), and the original EventSynchronizer oracle agrees;
+* **searchability**: MCTS, DFS and hill-climb all visit >= 2 distinct
+  sketch alternatives with zero solver changes (synthesized decompositions
+  are ordinary ChoiceOp alternatives next to the fixed engine);
+* **pruning**: ``bench/roofline.py::prune_sketches`` matches hand-computed
+  goldens (alpha-beta wire cost + per-step dispatch vs the fixed floor);
+* **numerics** (capability-gated: CI's jax has shard_map/pinned_host):
+  pure-movement sketches are bit-identical, synthesized reductions
+  allclose, vs the fixed-engine reference on a real mesh.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tenzing_tpu.bench import roofline
+from tenzing_tpu.bench.model import AnalyticBenchmarker
+from tenzing_tpu.bench.benchmarker import BenchOpts
+from tenzing_tpu.collectives.synth import (
+    SKETCHES,
+    SYNTH_MARK,
+    AddInto,
+    ConcatPieces,
+    PlaceSlice,
+    SlicePick,
+    StaticSlice,
+    SynthDirective,
+    plan_host_pipe,
+    plan_neighbor_shift,
+    plan_rhd_all_reduce,
+    plan_ring_all_reduce,
+    plan_ring_all_to_all,
+    sketch_cost_us,
+    sketch_menu,
+    synth_hidden_comm_measured_us,
+    synth_menu_info,
+    synth_menus,
+    synths_of,
+)
+from tenzing_tpu.collectives.topology import (
+    Topology,
+    host_topology,
+    mesh_topology,
+    ring_topology,
+)
+from tenzing_tpu.core.graph import Graph
+from tenzing_tpu.core.platform import Platform
+from tenzing_tpu.core.sequence import Sequence
+from tenzing_tpu.core.state import State
+from tenzing_tpu.models.halo import HaloArgs, add_to_graph, dir_name
+from tenzing_tpu.models.moe import MoEArgs, MoELayer
+from tenzing_tpu.models.spmv import SpMVCompound
+from tenzing_tpu.models.tp_mlp import TpMlp, TpMlpArgs, make_tp_mlp_buffers
+from tenzing_tpu.verify import ScheduleVerifier
+from tests.test_verify import synth_sound
+
+TP = TpMlpArgs(n_tp=4, n_layers=1, n_chunks=1, mb_size=2, d_model=8, d_ff=16)
+
+
+def _tp_graph(args=TP):
+    g = Graph()
+    op = TpMlp(args, synth=True, synth_relax=True)
+    g.start_then(op)
+    g.then_finish(op)
+    return g
+
+
+def _spmv_graph(n_rem=8):
+    g = Graph()
+    mk = lambda: SpMVCompound(x_sizes={"x_remote": n_rem},
+                              exchange="host", synth=True, synth_relax=True)
+    g.start_then(mk())
+    g.then_finish(mk())
+    return g
+
+
+def _halo_graph(args=None):
+    args = args if args is not None else HaloArgs(nq=2, lx=4, ly=4, lz=4,
+                                                  radius=1)
+    return add_to_graph(Graph(), args, synth=True, synth_relax=True)
+
+
+def _moe_graph(args=None):
+    args = args if args is not None else MoEArgs(
+        n_ep=4, tokens_per_shard=8, d_model=8, d_ff=16, n_chunks=2)
+    g = Graph()
+    op = MoELayer(args, synth=True, synth_relax=True)
+    g.start_then(op)
+    g.then_finish(op)
+    return g
+
+
+def _drive(g, plat, want_suffix=None):
+    """First-decision serialization, preferring choice alternatives whose
+    name ends with ``want_suffix`` (the test_chunking discipline)."""
+    st = State(g)
+    while not st.is_terminal():
+        ds = st.get_decisions(plat)
+        pick = None
+        if want_suffix is not None:
+            pick = next(
+                (d for d in ds
+                 if getattr(d, "choice", None) is not None
+                 and d.choice.name().endswith(want_suffix)), None)
+        st = st.apply(pick or ds[0])
+    return st
+
+
+# -- topology ---------------------------------------------------------------
+
+
+class TestTopology:
+    def test_ring_links_bidirectional(self):
+        t = ring_topology("tp", 4)
+        assert len(t.links) == 8  # 4 nodes x 2 directions
+        assert t.link("tp0", "tp1").engine == "ici"
+        assert t.link("tp3", "tp0") is not None  # wraparound
+        assert t.link("tp0", "tp2") is None  # no chord
+
+    def test_link_cost_alpha_beta(self):
+        t = ring_topology("tp", 2)
+        l = t.link("tp0", "tp1")
+        assert l.cost_us(0) == pytest.approx(l.alpha_us)
+        assert l.cost_us(1 << 20) > l.cost_us(1 << 10) > l.cost_us(0)
+
+    def test_host_topology_pcie(self):
+        t = host_topology()
+        assert t.link("d0", "host").engine == "pcie"
+        assert t.link("host", "d0").engine == "pcie"
+
+    def test_mesh_topology_merges_axes(self):
+        t = mesh_topology({"x": 2, "y": 2}, host=False)
+        assert t.link("x0", "x1") is not None
+        assert t.link("y0", "y1") is not None
+        assert "pcie" not in t.engines()
+        th = mesh_topology({"x": 2}, host=True)
+        assert "pcie" in th.engines()
+
+    def test_min_hops_on_ring(self):
+        t = ring_topology("tp", 4)
+        assert t.min_hops("tp0", "tp1") == 1
+        assert t.min_hops("tp0", "tp2") == 2  # either way around
+
+
+# -- protocol / serdes ------------------------------------------------------
+
+
+class TestProtocol:
+    def test_marker_literals_agree_with_featurizer(self):
+        """learn/features.py duplicates the marker + sketch vocabulary to
+        stay import-light; the literals must never drift."""
+        from tenzing_tpu.learn.features import _SYNTH_MARK, _SYNTH_SKETCHES
+
+        assert _SYNTH_MARK == SYNTH_MARK
+        assert _SYNTH_SKETCHES == SKETCHES
+
+    def test_directive_name_and_roundtrip(self):
+        d = SynthDirective("psum_0_0", "ring", 2)
+        assert d.name() == "psum_0_0.synth.ring.c2"
+        j = d.to_json()
+        d2 = SynthDirective.from_json(j)
+        assert (d2.base(), d2.sketch(), d2.chunks()) == ("psum_0_0", "ring", 2)
+
+    def test_directive_rejects_unknown_sketch(self):
+        with pytest.raises(ValueError, match="sketch"):
+            SynthDirective("a", "butterfly", 2)
+
+    def test_synths_of_parses_ops_and_strings(self):
+        d = SynthDirective("x_exchange", "pipe", 4)
+        got = synths_of([d, "psum_0_0.synth.ring.c2", "mlp_0_0", "start"])
+        assert got == {"x_exchange": {"sketch": "pipe", "chunks": 4},
+                       "psum_0_0": {"sketch": "ring", "chunks": 2}}
+
+    def test_synths_of_ignores_malformed(self):
+        assert synths_of(["a.synth.ring", "a.synth.butterfly.c2",
+                          "a.synth.ring.cX"]) == {}
+
+    def test_menu_info_leads_with_fixed_and_note_nonempty(self):
+        m = synth_menu_info("b", "all_reduce", ["ring.c1"], {"ring.c1": 2.0},
+                            {}, 5.0, "")
+        assert m["menu"][0] == "fixed"
+        assert m["note"]  # never empty — the perf.synth contract
+        empty = sketch_menu([], host_topology(), fixed_bytes=0.0)[1]
+        assert empty["note"]
+
+    def test_sketch_menu_relax_keeps_all_and_explains(self):
+        plans = [plan_ring_all_reduce("b", "s", "d", "tp", 4, (2, 8), k)
+                 for k in (1, 2)]
+        topo = mesh_topology({"tp": 4}, host=False)
+        variants, menu = sketch_menu(plans, topo, fixed_bytes=128.0,
+                                     relax=True, collective="all_reduce")
+        assert len(variants) == 2
+        assert menu["menu"] == ["fixed", "ring.c1", "ring.c2"]
+        assert "relax" in menu["note"]
+        assert set(menu["est_us"]) == {"ring.c1", "ring.c2"}
+
+    def test_sketch_cost_prices_every_hop(self):
+        p1 = plan_ring_all_reduce("b", "s", "d", "tp", 4, (2, 8), 1)
+        p2 = plan_ring_all_reduce("b", "s", "d", "tp", 4, (2, 8), 2)
+        topo = mesh_topology({"tp": 4}, host=False)
+        # same total bytes, same hop count per chunk -> same wire cost
+        # modulo per-transfer alpha (c2 posts twice as many transfers)
+        assert sketch_cost_us(p2, topo) > 0
+        assert p2.n_xfers == 2 * p1.n_xfers
+        assert sketch_cost_us(p2, topo) > sketch_cost_us(p1, topo)
+
+
+# -- roofline pruning goldens -----------------------------------------------
+
+
+class TestPruneSketches:
+    def test_keeps_only_below_floor(self):
+        cands = {"ring.c1": {"est_us": 10.0, "steps": 1, "chunks": 1},
+                 "rhd.c1": {"est_us": 40.0, "steps": 1, "chunks": 1}}
+        kept, pruned = roofline.prune_sketches(cands, fixed_floor_us=20.0,
+                                               dispatch_us=0.0)
+        assert kept == ["ring.c1"]
+        assert "rhd.c1" in pruned and "floor" in pruned["rhd.c1"]
+
+    def test_extra_posts_pay_dispatch(self):
+        # 3 steps at 25us dispatch each adds 50us over the fixed one-post
+        cands = {"ring.c1": {"est_us": 10.0, "steps": 3, "chunks": 1}}
+        kept, pruned = roofline.prune_sketches(cands, fixed_floor_us=20.0,
+                                               dispatch_us=25.0)
+        assert not kept and "dispatch" in pruned["ring.c1"]
+
+    def test_overlap_credit_capped_by_head_chunk(self):
+        # a k-chunk pipeline hides at most est*(k-1)/k, not all of it
+        cands = {"pipe.c2": {"est_us": 30.0, "steps": 1, "chunks": 2}}
+        kept, _ = roofline.prune_sketches(cands, fixed_floor_us=16.0,
+                                          overlap_us=1e9, dispatch_us=0.0)
+        assert kept == ["pipe.c2"]  # eff = 30 - 15 = 15 < 16
+        kept2, _ = roofline.prune_sketches(cands, fixed_floor_us=14.0,
+                                           overlap_us=1e9, dispatch_us=0.0)
+        assert not kept2
+
+
+# -- step-op algebra (single device, no mesh) -------------------------------
+
+
+class TestStepOps:
+    def _apply(self, op, bufs):
+        out = dict(bufs)
+        out.update(op.apply({k: jnp.asarray(v) for k, v in out.items()},
+                            None))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def test_slice_pick_place_roundtrip(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        bufs = {"x": x, "piece": np.zeros((2, 3), np.float32),
+                "y": np.zeros((4, 3), np.float32)}
+        for j in range(2):
+            bufs = self._apply(SlicePick(f"p{j}", "x", "piece", j, 2), bufs)
+            bufs = self._apply(PlaceSlice(f"q{j}", "piece", "y", j, 2), bufs)
+        np.testing.assert_array_equal(bufs["y"], x)
+
+    def test_slice_pick_rejects_uneven_runtime_rows(self):
+        op = SlicePick("p", "x", "d", 0, 3)
+        with pytest.raises(ValueError, match="split"):
+            op.apply({"x": jnp.zeros((4, 2))}, None)
+
+    def test_add_into_accumulates(self):
+        bufs = {"acc": np.ones((2, 2), np.float32),
+                "p": np.full((2, 2), 2.0, np.float32)}
+        bufs = self._apply(AddInto("a", "p", "acc"), bufs)
+        np.testing.assert_array_equal(bufs["acc"], np.full((2, 2), 3.0))
+
+    def test_static_slice_concat_roundtrip_uneven(self):
+        x = np.arange(7, dtype=np.float32)
+        bufs = {"x": x, "a": np.zeros(4, np.float32),
+                "b": np.zeros(3, np.float32), "y": np.zeros(7, np.float32)}
+        bufs = self._apply(StaticSlice("s0", "x", "a", 0, 4), bufs)
+        bufs = self._apply(StaticSlice("s1", "x", "b", 4, 3), bufs)
+        bufs = self._apply(ConcatPieces("c", ["a", "b"], "y"), bufs)
+        np.testing.assert_array_equal(bufs["y"], x)
+
+
+# -- plan census ------------------------------------------------------------
+
+
+class TestPlans:
+    def test_ring_all_reduce_census(self):
+        p = plan_ring_all_reduce("b", "s", "d", "tp", 4, (4, 8), 2)
+        assert p.label() == "ring.c2"
+        assert p.n_xfers == 2 * 3  # k chunks x (n-1) hops
+        assert len(p.chains) == 2
+        names = [d.name for d in p.buffers]
+        assert len(names) == len(set(names))  # no staging-name collisions
+
+    def test_reverse_ring_is_distinct_sketch(self):
+        p = plan_ring_all_reduce("b", "s", "d", "tp", 4, (4, 8), 1,
+                                 reverse=True)
+        assert p.sketch == "ringr"
+
+    def test_rhd_requires_power_of_two(self):
+        with pytest.raises(ValueError, match="power"):
+            plan_rhd_all_reduce("b", "s", "d", "tp", 3, (4, 8))
+        p = plan_rhd_all_reduce("b", "s", "d", "tp", 8, (4, 8))
+        assert p.n_xfers == 3  # log2(8) hops
+
+    def test_a2a_ring_requires_extent(self):
+        with pytest.raises(ValueError, match=">= 2"):
+            plan_ring_all_to_all("b", "s", "d", "ep", 1, (4, 8))
+
+    def test_host_pipe_declares_host_space(self):
+        p = plan_host_pipe("b", "s", "d", 8, 2)
+        assert p.engine == "pcie"
+        assert any(d.space == "host" for d in p.buffers)
+
+
+# -- graph-level soundness of every opted-in model --------------------------
+
+
+class TestGraphLevel:
+    """Drive each model's sketch-extended graph to both a synthesized and a
+    fixed-engine projection; the independent verifier and the original
+    oracle must certify both, and the directive must be readable back."""
+
+    def _check(self, g, want_suffix, expect_sites, sketch):
+        plat = Platform.make_n_lanes(1)
+        st = _drive(g, plat, want_suffix)
+        chosen = synths_of(st.sequence)
+        assert len(chosen) == expect_sites, chosen
+        assert all(v["sketch"] == sketch for v in chosen.values()), chosen
+        stf = _drive(g, plat, ".fixed")
+        assert synths_of(stf.sequence) == {}
+        for s in (st, stf):
+            v = ScheduleVerifier(s.graph)(s.sequence)
+            assert v.ok, f"false positive: {v.witness()}"
+            assert synth_sound(s.graph, s.sequence)
+        return st
+
+    def test_spmv_pipe(self):
+        g = _spmv_graph(n_rem=8)
+        menus = synth_menus(g)
+        assert set(menus) == {"x_exchange"}
+        assert menus["x_exchange"]["menu"] == ["fixed", "pipe.c2", "pipe.c4"]
+        self._check(g, "pipe.c2", 1, "pipe")
+
+    def test_tp_mlp_all_reduce_menu(self):
+        g = _tp_graph()
+        menus = synth_menus(g)
+        assert set(menus) == {"psum_0_0"}
+        assert menus["psum_0_0"]["menu"] == [
+            "fixed", "ring.c1", "ring.c2", "ringr.c1", "rhd.c1"]
+        self._check(g, "rhd.c1", 1, "rhd")
+        self._check(g, "ring.c2", 1, "ring")
+
+    def test_halo_neighbor_all_faces(self):
+        g = _halo_graph()
+        menus = synth_menus(g)
+        assert len(menus) == 6  # one exchange site per face direction
+        for m in menus.values():
+            assert m["menu"] == ["fixed", "neighbor.c1", "neighbor.c2"]
+        self._check(g, "neighbor.c2", 6, "neighbor")
+
+    def test_moe_a2a_both_sites(self):
+        g = _moe_graph()
+        menus = synth_menus(g)
+        assert set(menus) == {"a2a_disp_0", "a2a_comb_0",
+                              "a2a_disp_1", "a2a_comb_1"}
+        for m in menus.values():
+            assert m["menu"] == ["fixed", "ring.c1"]
+        self._check(g, "ring.c1", 4, "ring")
+
+
+# -- verifier fuzz over synthesized projections -----------------------------
+
+
+class TestVerifierFuzz:
+    @pytest.mark.parametrize("mk_graph,label", [(_spmv_graph, "spmv"),
+                                                (_halo_graph, "halo")])
+    def test_randomized_synth_rollouts_verify_clean(self, mk_graph, label):
+        """Randomized sketch/chunk rollouts (biased toward synthesized
+        alternatives so the fuzz actually exercises them): 0 false
+        positives from the independent verifier, and the original
+        EventSynchronizer oracle agrees on every projection."""
+        g = mk_graph()
+        ver = ScheduleVerifier(g)
+        rng = random.Random(17)
+        n_synthed = 0
+        for _ in range(10):
+            st = State(g)
+            while not st.is_terminal():
+                ds = st.get_decisions(Platform.make_n_lanes(2))
+                pick = next(
+                    (d for d in ds
+                     if getattr(d, "choice", None) is not None
+                     and ".synthed." in d.choice.name()
+                     and rng.random() < 0.7), None)
+                st = st.apply(pick or ds[rng.randrange(len(ds))])
+            v = ver(st.sequence)
+            assert v.ok, f"false positive: {v.witness()}"
+            assert synth_sound(st.graph, st.sequence)
+            n_synthed += bool(synths_of(st.sequence))
+        assert ver.unsound == 0
+        assert n_synthed >= 3, f"{label} fuzz barely hit synth projections"
+
+
+# -- solver searchability (analytic model, no device) -----------------------
+
+
+class TestSolversSearchSketches:
+    """Synthesized decompositions are ordinary choice decisions: all three
+    solvers visit >= 2 distinct sketch alternatives (the fixed engine
+    counts as one) with zero solver changes, scored by the analytic model
+    so the test needs no mesh."""
+
+    def _bench(self):
+        bufs, _, _ = make_tp_mlp_buffers(TP, seed=0, synth=True)
+        return AnalyticBenchmarker({k: v.nbytes for k, v in bufs.items()})
+
+    def _seen(self, sims):
+        seen = set()
+        for s in sims:
+            labels = {f"{v['sketch']}.c{v['chunks']}"
+                      for v in synths_of(s.order).values()}
+            seen.update(labels or {"fixed"})
+        return seen
+
+    def test_dfs_enumerates_sketches(self):
+        from tenzing_tpu.solve.dfs import DfsOpts, explore
+
+        res = explore(
+            _tp_graph(), Platform.make_n_lanes(1), self._bench(),
+            DfsOpts(max_seqs=24, dump_csv_path="/dev/null",
+                    bench_opts=BenchOpts(n_iters=1, target_secs=0.0)))
+        seen = self._seen(res.sims)
+        assert "fixed" in seen and len(seen) >= 2, seen
+
+    def test_hill_climb_searches_sketches(self):
+        from tenzing_tpu.solve.local import LocalOpts, hill_climb
+
+        def prefer(op_name, choices):
+            # seed fixed-engine; flip moves must explore the sketch menu
+            return next((c for c in choices if c.endswith(".fixed")), None)
+
+        res = hill_climb(
+            _tp_graph(), Platform.make_n_lanes(1), self._bench(),
+            phases=("mlp",), prefer=prefer,
+            opts=LocalOpts(budget=8, seed=0,
+                           bench_opts=BenchOpts(n_iters=1, target_secs=0.0)))
+        assert res.sims
+        seen = self._seen(res.sims)
+        assert len(seen) >= 2, seen
+
+    def test_mcts_searches_sketches(self):
+        from tenzing_tpu.solve.mcts import MctsOpts, explore
+
+        res = explore(
+            _tp_graph(), Platform.make_n_lanes(1), self._bench(),
+            MctsOpts(n_iters=16, seed=3,
+                     bench_opts=BenchOpts(n_iters=1, target_secs=0.0),
+                     screen_opts=BenchOpts(n_iters=1, target_secs=0.0)))
+        seen = self._seen(res.sims)
+        assert len(seen) >= 2, seen
+
+
+# -- feature markers --------------------------------------------------------
+
+
+class TestFeatureMarkers:
+    def test_synth_directives_counted(self):
+        from tenzing_tpu.learn.features import FEATURE_NAMES, featurize
+
+        seq = Sequence([SynthDirective("a", "ring", 2),
+                        SynthDirective("b", "pipe", 4),
+                        SynthDirective("c", "ring", 1)])
+        v = dict(zip(FEATURE_NAMES, featurize(seq)))
+        assert v["n_synth_dir"] == 3.0
+        assert v["n_synth_ring"] == 2.0
+        assert v["n_synth_pipe"] == 1.0
+        assert v["n_synth_neighbor"] == 0.0
+        assert v["sum_synth_chunks"] == 7.0
+
+    def test_step_names_do_not_count_as_directives(self):
+        """A p2p step (``b.ring2.x0.p0``) is not a directive: only the
+        ``<base>.synth.<sketch>.cK`` op carries the feature unit."""
+        from tenzing_tpu.learn.features import FEATURE_NAMES, featurize
+
+        plan = plan_ring_all_reduce("b", "s", "d", "tp", 2, (2, 4), 2)
+        names = [op for chain in plan.chains for op in chain]
+        v = dict(zip(FEATURE_NAMES, featurize(Sequence(names))))
+        assert v["n_synth_dir"] == 0.0
+
+    def test_save_load_contract_rejects_pre_synth_model(self, tmp_path):
+        """A model saved under the pre-synth-append name list fails the
+        load contract loudly instead of silently mis-predicting."""
+        from tenzing_tpu.learn import RidgeEnsemble
+        from tenzing_tpu.learn.features import FEATURE_NAMES
+
+        rng = np.random.default_rng(0)
+        old_names = list(FEATURE_NAMES[:-7])
+        X = rng.random((8, len(old_names)))
+        old = RidgeEnsemble(feature_names=old_names).fit(X, rng.random(8))
+        path = str(tmp_path / "pre_synth.json")
+        old.save(path)
+        with pytest.raises(ValueError, match="contract"):
+            RidgeEnsemble.load(path, expect_features=list(FEATURE_NAMES))
+
+
+# -- measured hidden comm ---------------------------------------------------
+
+
+class _FakeOp:
+    def __init__(self, name, kind=""):
+        self._name, self.KIND = name, kind
+
+    def name(self):
+        return self._name
+
+
+class _FakeTimeline:
+    def __init__(self, records):
+        self.records = records
+
+
+class _FakeAttrib:
+    def __init__(self, records):
+        self.timeline = _FakeTimeline(records)
+
+
+class TestHiddenCommMeasured:
+    def test_overlap_interval_sum(self):
+        from tenzing_tpu.obs.attrib.timeline import OpRecord
+
+        ops = [_FakeOp("ex.synth.neighbor.c2"),
+               _FakeOp("ex.neighbor2.x0.p", kind="permute_start"),
+               _FakeOp("compute_a"),
+               _FakeOp("ex.neighbor2.x1.p", kind="permute_start"),
+               _FakeOp("compute_b")]
+        recs = [
+            OpRecord("ex.neighbor2.x0.p", "", "device", 0, (1,),
+                     dur_us=10.0, start_us=0.0),
+            OpRecord("compute_a", "", "device", 1, (2,),
+                     dur_us=10.0, start_us=5.0),  # 5us under x0.p
+            OpRecord("ex.neighbor2.x1.p", "", "device", 0, (3,),
+                     dur_us=4.0, start_us=15.0),
+            OpRecord("compute_b", "", "device", 1, (4,),
+                     dur_us=2.0, start_us=16.0),  # fully under x1.p
+        ]
+        got = synth_hidden_comm_measured_us(ops, _FakeAttrib(recs))
+        assert got == pytest.approx(7.0)
+
+    def test_zero_without_chosen_synth(self):
+        assert synth_hidden_comm_measured_us(
+            [_FakeOp("compute_a")], _FakeAttrib([])) == 0.0
+
+
+# -- executed numerics (capability-gated: run in CI's capable jax) ----------
+
+
+@pytest.mark.needs_shard_map
+class TestExecutedNumerics:
+    def test_tp_mlp_synth_matches_fixed_psum(self):
+        """Every sketch the tp all-reduce menu offers must agree with the
+        host reference: pure movement is exact, re-associated reductions
+        allclose (the driver's integrity-gate tolerance discipline)."""
+        from jax.sharding import Mesh
+        from tenzing_tpu.runtime.executor import TraceExecutor
+
+        bufs, specs, want = make_tp_mlp_buffers(TP, seed=1, synth=True)
+        devs = np.array(jax.devices()[:TP.n_tp])
+        plat = Platform.make_n_lanes(2, mesh=Mesh(devs, ("tp",)),
+                                     specs=specs)
+        ex = TraceExecutor(plat,
+                           {k: jnp.asarray(v) for k, v in bufs.items()})
+        g = _tp_graph()
+        for suffix in (".fixed", "ring.c1", "ring.c2", "ringr.c1", "rhd.c1"):
+            st = _drive(g, plat, suffix)
+            out = ex.run(st.sequence)
+            np.testing.assert_allclose(np.asarray(out["Y"]), want,
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=f"sketch {suffix}")
+
+    def test_moe_synth_a2a_bit_identical(self):
+        """The ring all-to-all is pure movement: synthesized routing must
+        reproduce the fused ``lax.all_to_all`` output exactly."""
+        from jax.sharding import Mesh
+        from tenzing_tpu.models.moe import make_moe_buffers
+        from tenzing_tpu.runtime.executor import TraceExecutor
+
+        args = MoEArgs(n_ep=4, tokens_per_shard=8, d_model=8, d_ff=16,
+                       n_chunks=2)
+        bufs, specs, want = make_moe_buffers(args, seed=0, synth=True)
+        devs = np.array(jax.devices()[:args.n_ep])
+        plat = Platform.make_n_lanes(2, mesh=Mesh(devs, ("ep",)),
+                                     specs=specs)
+        ex = TraceExecutor(plat,
+                           {k: jnp.asarray(v) for k, v in bufs.items()})
+        g = _moe_graph(args)
+        out_fixed = ex.run(_drive(g, plat, ".fixed").sequence)
+        out_ring = ex.run(_drive(g, plat, "ring.c1").sequence)
+        np.testing.assert_allclose(np.asarray(out_ring["Y"]), want,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(out_ring["Y"]),
+                                      np.asarray(out_fixed["Y"]))
+
+    def test_halo_synth_shift_bit_identical(self):
+        """Chunked neighbor-exchange is pure movement: every face arrives
+        bit-identical to the fused shift."""
+        from jax.sharding import Mesh
+        from tenzing_tpu.models.halo import make_halo_buffers
+        from tenzing_tpu.runtime.executor import TraceExecutor
+
+        args = HaloArgs(nq=2, lx=4, ly=4, lz=4, radius=1)
+        mesh_shape = (2, 2, 2)
+        bufs, specs, want = make_halo_buffers(mesh_shape, args, seed=0,
+                                              synth=True)
+        devs = np.array(jax.devices()[:8]).reshape(mesh_shape)
+        plat = Platform.make_n_lanes(2, mesh=Mesh(devs, ("x", "y", "z")),
+                                     specs=specs)
+        ex = TraceExecutor(plat,
+                           {k: jnp.asarray(v) for k, v in bufs.items()})
+        g = _halo_graph(args)
+        out = ex.run(_drive(g, plat, "neighbor.c2").sequence)
+        np.testing.assert_allclose(np.asarray(out["U"]), want, rtol=1e-6)
