@@ -96,9 +96,15 @@ DEFAULT_RULES: Dict[str, Dict[str, Any]] = {
     # arrival must exceed drain by this factor (and by a non-trivial
     # absolute rate) with work actually queued before paging — a
     # momentarily idle fleet with an empty queue is not a backlog
+    # max_daemons clamps the recommendation (and the supervisor's
+    # scale-up bound): null = ~os.cpu_count(), 0 = unclamped
     "queue_backlog_burn": {"enabled": True, "severity": "page",
                            "burn_ratio": 1.2,
-                           "min_arrival_per_s": 0.1},
+                           "min_arrival_per_s": 0.1,
+                           "max_daemons": None},
+    # fired from the supervisor's status-doc breaker block: a member
+    # slot crash-looped past its restart budget and sits quarantined
+    "supervisor_crash_loop": {"enabled": True, "severity": "page"},
 }
 
 
@@ -361,6 +367,28 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
                     f"{st.get('state', '?')!r}: the process likely died "
                     "without stopping"))
 
+    r = on("supervisor_crash_loop")
+    if r:
+        for st in seen_status:
+            if st.get("kind") != "supervisor":
+                continue
+            sup = str(st.get("owner", st.get("_file", "?")))
+            for member, b in sorted((st.get("breakers") or {}).items()):
+                if not isinstance(b, dict) or \
+                        b.get("state") not in ("open", "half_open"):
+                    continue
+                alerts.append(Alert(
+                    "supervisor_crash_loop", member, r["severity"],
+                    {"state": b.get("state"),
+                     "restarts": b.get("restarts_in_window")},
+                    {"max_restarts": b.get("max_restarts"),
+                     "window_s": b.get("window_s")},
+                    f"member {member!r} crash-looped "
+                    f"({b.get('restarts_in_window')} restart(s) inside "
+                    f"{b.get('window_s')}s): quarantined by {sup} with "
+                    f"the breaker {b.get('state')} — the fleet is "
+                    "degraded, not flapping"))
+
     for qd in dict.fromkeys(queue_dirs):
         try:
             names = sorted(os.listdir(qd))
@@ -400,7 +428,8 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
 
     r = on("queue_backlog_burn")
     if r:
-        bl = backlog_summary(store_dirs, queue_dirs)
+        bl = backlog_summary(store_dirs, queue_dirs,
+                             max_daemons=r.get("max_daemons"))
         arrival, drain = bl["arrival_per_s"], bl["drain_per_s"]
         burning = (arrival >= r["min_arrival_per_s"] and bl["depth"] > 0
                    and (drain <= 0 or arrival / drain >= r["burn_ratio"]))
@@ -420,15 +449,20 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
 
 
 def backlog_summary(store_dirs: List[str],
-                    queue_dirs: List[str]) -> Dict[str, Any]:
+                    queue_dirs: List[str],
+                    max_daemons: Optional[int] = None) -> Dict[str, Any]:
     """Arrival-vs-drain economics for the ``queue_backlog_burn`` rule
     and the follow view's ``burn`` line: arrival/s from reqlog position
     deltas across each live serve loop's snapshot ring (fallback: the
     served+shed+timeout counter deltas), fleet drain/s from each live
     daemon's measured per-item wall clock (status-doc history), queue
     depth from the work files themselves, and the daemon count that
-    would balance the two.  Read-only and damage-tolerant: unreadable
-    pieces contribute zero, never raise."""
+    would balance the two.  ``recommended_daemons`` is clamped to
+    ``max_daemons`` (``None`` = ~os.cpu_count(); ``0`` = unclamped —
+    the raw figure stays in ``recommended_daemons_raw``) so one burst
+    against a slow drain cannot recommend an absurd fleet for the
+    host.  Read-only and damage-tolerant: unreadable pieces contribute
+    zero, never raise."""
     import math
 
     from tenzing_tpu.obs.metrics import snapshot_history
@@ -483,7 +517,10 @@ def backlog_summary(store_dirs: List[str],
         except OSError:
             continue
         for st in docs:
-            if st.get("kind") == "serve_loop" or \
+            # only drain daemons count toward fleet capacity — the
+            # serve loop and the supervisor publish the same status
+            # shape but drain nothing
+            if st.get("kind") in ("serve_loop", "supervisor") or \
                     st.get("state") == "stopped":
                 continue
             ws = []
@@ -512,11 +549,17 @@ def backlog_summary(store_dirs: List[str],
         recommended = max(1, int(math.ceil(arrival * per_item_s)))
     else:
         recommended = max(1, daemons)
+    if max_daemons is None:
+        max_daemons = os.cpu_count() or 4
+    clamped = recommended if max_daemons <= 0 \
+        else min(recommended, int(max_daemons))
     return {"arrival_per_s": round(arrival, 3),
             "drain_per_s": round(drain, 3),
             "daemons": daemons, "depth": depth,
             "per_item_s": round(per_item_s, 3) if per_item_s else None,
-            "recommended_daemons": recommended}
+            "recommended_daemons": clamped,
+            "recommended_daemons_raw": recommended,
+            "max_daemons": max_daemons if max_daemons > 0 else None}
 
 
 # -- firing/resolved state machine -------------------------------------------

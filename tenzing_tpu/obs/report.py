@@ -1051,6 +1051,33 @@ def fleet_lines(store_dirs: List[str],
                 continue
             if st.get("kind") == "serve_loop":
                 continue  # a listen loop pointed at the queue dir
+            if st.get("kind") == "supervisor":
+                # the fleet controller (serve/supervisor.py): members,
+                # scaling verdict, and any open crash-loop breakers
+                sc = st.get("scaling") or {}
+                lines.append(
+                    f"superv {st.get('owner', name)}: "
+                    f"{st.get('state')}, hb "
+                    f"{_age(st, 'heartbeat_at', now)} ago, members "
+                    f"{st.get('n_members', 0)} (desired "
+                    f"{sc.get('desired', st.get('desired_n', '?'))})"
+                    + (", scale-up suppressed (poison)"
+                       if sc.get("suppressed_poison") else ""))
+                for mb in st.get("members") or []:
+                    lines.append(
+                        f"       member {mb.get('owner')}: "
+                        f"{mb.get('state')}"
+                        + (" (adopted)" if mb.get("adopted") else "")
+                        + (f", {mb.get('restarts')} restart(s)"
+                           if mb.get("restarts") else ""))
+                for owner, b in sorted(
+                        (st.get("breakers") or {}).items()):
+                    lines.append(
+                        f"       breaker {owner}: {b.get('state')} "
+                        f"({b.get('restarts_in_window')}/"
+                        f"{b.get('max_restarts')} restarts in "
+                        f"{b.get('window_s')}s)")
+                continue
             c = st.get("counters", {})
             item = st.get("item") or {}
             lines.append(

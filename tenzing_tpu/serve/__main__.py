@@ -157,6 +157,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     pl.add_argument("--shed-retry-after", type=float, default=0.5,
                     metavar="SECS",
                     help="retry_after hint carried by shed responses")
+    pl.add_argument("--busy-poll-us", type=float, default=0.0,
+                    metavar="US",
+                    help="worker busy-poll window: spin this many µs "
+                         "for the next request before blocking — buys "
+                         "back the OS wake floor on the exact-tier "
+                         "tail at the cost of an idle-spinning core "
+                         "(0 = blocking waits)")
     pl.add_argument("--heartbeat", type=float, default=2.0, metavar="SECS",
                     help="status-document rewrite interval")
     pl.add_argument("--idle-exit", type=float, default=None, metavar="SECS",
@@ -249,6 +256,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             tenant_max_pending=args.tenant_max_pending,
             request_timeout_secs=args.request_timeout or 0.0,
             shed_retry_after_secs=args.shed_retry_after,
+            busy_poll_us=args.busy_poll_us,
             heartbeat_secs=args.heartbeat,
             idle_exit_secs=args.idle_exit, owner=args.owner or "",
             status_path=args.status, socket_path=args.socket,

@@ -126,6 +126,12 @@ class ListenOpts:
     status_path: Optional[str] = None
     socket_path: Optional[str] = None
     handle_signals: bool = True
+    # busy-poll worker mode (docs/serving.md "Busy-poll workers"):
+    # each worker spins on get_nowait() for up to this many µs before
+    # falling back to the blocking wait — buys back the OS timer-wake
+    # floor on the exact-tier tail (obs/noise.py measures that floor)
+    # at the cost of burning a core while idle.  0 = blocking waits.
+    busy_poll_us: float = 0.0
     # -- telemetry plane (docs/observability.md) --
     slo_target_us: Optional[float] = None    # exact-tier pct99 objective
     slo_baseline: Optional[str] = None       # SERVE_BENCH_r*.json path
@@ -586,10 +592,28 @@ class ServeLoop:
                 "result": self._resolve_one(payload.get("request") or {},
                                             tenant=tenant)}
 
+    def _next_pending(self):
+        """One queue fetch: a bounded ``get_nowait()`` spin first
+        (``busy_poll_us``), then the blocking wait.  A request landing
+        during the spin window is picked up at sub-microsecond latency
+        instead of paying the condition-variable wake floor; a quiet
+        window degrades to exactly the old blocking behavior."""
+        spin_s = self.opts.busy_poll_us / 1e6
+        if spin_s > 0 and not self._stop.is_set():
+            deadline = time.perf_counter() + spin_s
+            while True:
+                try:
+                    return self._queue.get_nowait()
+                except _queue.Empty:
+                    if self._stop.is_set() or \
+                            time.perf_counter() >= deadline:
+                        break
+        return self._queue.get(timeout=0.1)
+
     def _worker(self) -> None:
         while True:
             try:
-                pending = self._queue.get(timeout=0.1)
+                pending = self._next_pending()
             except _queue.Empty:
                 if self._stop.is_set():
                     return

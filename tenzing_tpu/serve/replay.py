@@ -300,7 +300,8 @@ def _replay_segmented(seg_path: str, queue_dir: str,
                       trace: List[Dict[str, Any]], qps: float,
                       max_pending: int, workers: int,
                       request_timeout: float, log,
-                      record_dir: Optional[str] = None) -> Dict[str, Any]:
+                      record_dir: Optional[str] = None,
+                      busy_poll_us: float = 0.0) -> Dict[str, Any]:
     """The post-PR path through the real ServeLoop, paced at the target
     QPS — shed and timeout counts are measured behavior.  With
     ``record_dir`` the loop additionally records the replayed traffic
@@ -327,6 +328,7 @@ def _replay_segmented(seg_path: str, queue_dir: str,
         request_timeout_secs=request_timeout,
         status_path=os.path.join(seg_path, "status-replay.json"),
         owner="replay", handle_signals=False,
+        busy_poll_us=busy_poll_us,
         record_dir=record_dir), log=log)
     loop.start()
     results: List[Dict[str, Any]] = []
@@ -378,6 +380,7 @@ def _replay_segmented(seg_path: str, queue_dir: str,
     fp_probed = fast["serve.fp_cache.hits"] + fast["serve.fp_cache.misses"]
     return {
         "mode": "segmented",
+        "busy_poll_us": busy_poll_us,
         **({"reqlog": out_reqlog} if out_reqlog else {}),
         "resolve_us": _series(lat),
         "phases_us": _phase_series(phases),
@@ -419,6 +422,7 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
                pacing: Optional[Dict[str, Any]] = None,
                fleet_scaling: Optional[Dict[str, Any]] = None,
                noise_samples: int = 64,
+               busy_poll_us: float = 0.0,
                log=None) -> Dict[str, Any]:
     """The whole benchmark; returns the result document (see module
     docstring).  ``trace`` (with its ``recorded`` provenance block, from
@@ -458,7 +462,7 @@ def run_replay(csv_globs: Dict[str, List[str]], n: int = 1200,
         seg = _replay_segmented(
             stores["seg"], os.path.join(workdir, "q-seg"), trace, qps,
             max_pending, workers, request_timeout, log,
-            record_dir=record_dir)
+            record_dir=record_dir, busy_poll_us=busy_poll_us)
         speedup = None
         le = legacy["resolve_us"].get("exact")
         se = seg["resolve_us"].get("exact")
@@ -532,6 +536,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="winners warmed per workload")
     ap.add_argument("--max-pending", type=int, default=256)
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--busy-poll-us", type=float, default=0.0,
+                    help="segmented-path worker busy-poll window in µs "
+                         "(serve listen --busy-poll-us; 0 = blocking "
+                         "waits) — recorded in the result's segmented "
+                         "block")
     ap.add_argument("--request-timeout", type=float, default=30.0)
     ap.add_argument("--noise-samples", type=int, default=64,
                     help="host-noise floor probe samples stamped into "
@@ -596,7 +605,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                      recorded=recorded,
                      pacing={"source": pacing_source},
                      fleet_scaling=fleet_scaling,
-                     noise_samples=args.noise_samples, log=log)
+                     noise_samples=args.noise_samples,
+                     busy_poll_us=args.busy_poll_us, log=log)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)

@@ -217,13 +217,25 @@ def test_backlog_summary_and_burn_rule(tree):
     ])
     json.dump({"kind": "search_request"},
               open(os.path.join(queue, "work-x.json"), "w"))
-    bl = backlog_summary([store], [queue])
+    bl = backlog_summary([store], [queue], max_daemons=0)
     assert bl["arrival_per_s"] == 10.0
     assert bl["drain_per_s"] == 0.5
     assert bl["daemons"] == 1 and bl["depth"] == 1
     assert bl["per_item_s"] == 2.0
     assert bl["recommended_daemons"] == 20  # ceil(10/s * 2s/item)
-    alerts = [a for a in evaluate([store], [queue], now=NOW)
+    assert bl["recommended_daemons_raw"] == 20
+    assert bl["max_daemons"] is None  # 0 = unclamped
+    # default clamps to os.cpu_count(); explicit bound wins
+    clamped = backlog_summary([store], [queue], max_daemons=3)
+    assert clamped["recommended_daemons"] == 3
+    assert clamped["recommended_daemons_raw"] == 20
+    assert clamped["max_daemons"] == 3
+    dflt = backlog_summary([store], [queue])
+    assert dflt["max_daemons"] == (os.cpu_count() or 4)
+    assert dflt["recommended_daemons"] == min(20, dflt["max_daemons"])
+    rules = load_rules(
+        sets=["queue_backlog_burn.max_daemons=0"])  # unclamped
+    alerts = [a for a in evaluate([store], [queue], rules=rules, now=NOW)
               if a.rule == "queue_backlog_burn"]
     assert len(alerts) == 1
     a = alerts[0]

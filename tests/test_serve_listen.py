@@ -241,6 +241,27 @@ def test_bounded_queue_sheds_with_retry_after(tmp_path):
     assert get_metrics().counter("serve.shed").value == shed0 + len(shed)
 
 
+def test_busy_poll_workers_drain_correctly(tmp_path):
+    """``busy_poll_us`` changes the worker wakeup path (bounded spin
+    before the blocking wait), never the results: every request is
+    answered exactly once, and drain/stop still terminate promptly."""
+    svc = _StubService(delay=0.0)
+    loop = ServeLoop(svc, ListenOpts(
+        max_pending=32, workers=2, request_timeout_secs=30.0,
+        busy_poll_us=200.0, handle_signals=False,
+        status_path=str(tmp_path / "status.json")))
+    loop.start()
+    docs, respond = _collect()
+    for i in range(12):
+        loop.submit({"op": "query", "id": i,
+                     "request": {"workload": "spmv", "m": 512}}, respond)
+    assert loop.drain(timeout=10.0) is True
+    assert len(docs) == 12
+    assert sum(1 for d in docs if d.get("ok")) == 12
+    assert sorted(d["id"] for d in docs) == list(range(12))
+    assert svc.calls == 12
+
+
 def test_watchdog_times_out_stuck_request(tmp_path):
     svc = _StubService(delay=1.0)
     loop = ServeLoop(svc, ListenOpts(

@@ -1,0 +1,705 @@
+"""Self-healing fleet supervisor acceptance (ISSUE 18).
+
+Unit layers drive :class:`Supervisor` tick-by-tick with fake member
+handles and a synthetic clock (no sleeps): the crash-loop breaker's
+closed/open/half-open cycle, restart backoff, quarantine + the
+``supervisor_crash_loop`` page, the scaling policy's hysteresis /
+cooldown / poison suppression, adoption from live status docs, and the
+retention GC's never-touch-live rules.
+
+The chaos layer runs the real thing: ``python -m
+tenzing_tpu.serve.supervisor`` subprocesses over a real queue — a
+member SIGKILLed mid-drain restarts and completes its item exactly
+once via journal resume; a SIGKILLed *supervisor* is succeeded by one
+that adopts the still-running member instead of double-spawning (and a
+third contender is excluded by the controller lease, rc 3); a
+crash-looping member ends the run quarantined with the breaker open,
+the alert firing, and rc 1.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tenzing_tpu.bench.driver import DriverRequest
+from tenzing_tpu.fault.backoff import BackoffPolicy
+from tenzing_tpu.obs.alerts import evaluate
+from tenzing_tpu.serve.fingerprint import fingerprint_of
+from tenzing_tpu.serve.store import WorkQueue
+from tenzing_tpu.serve.supervisor import (
+    ALERTS_NAME,
+    CrashLoopBreaker,
+    MemberSlot,
+    Supervisor,
+    SupervisorOpts,
+    _subprocess_member_spawn,
+    gc_stale_artifacts,
+    supervisor_exit_code,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- harness -----------------------------------------------------------------
+
+class FakeHandle:
+    """A member handle the test scripts: alive until ``die(rc)``."""
+
+    def __init__(self, owner):
+        self.owner = owner
+        self.pid = 99999
+        self.returncode = None
+        self.signals = []
+        self._alive = True
+
+    def alive(self):
+        return self._alive
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+    def die(self, rc):
+        self._alive, self.returncode = False, rc
+
+
+def _sup(tmp_path, spawn=None, **kw):
+    qdir = str(tmp_path / "q")
+    store = str(tmp_path / "store")
+    os.makedirs(qdir, exist_ok=True)
+    os.makedirs(store, exist_ok=True)
+    opts = SupervisorOpts(queue_dir=qdir, store_path=store,
+                          handle_signals=False, compact_interval_secs=0,
+                          gc_interval_secs=0, **kw)
+    spawn = spawn or (lambda o, s: FakeHandle(s.owner))
+    return Supervisor(opts, spawn=spawn, log=lambda m: None)
+
+
+# -- the breaker -------------------------------------------------------------
+
+def test_breaker_open_half_open_close_cycle():
+    br = CrashLoopBreaker(max_restarts=3, window_secs=60.0,
+                          quarantine_secs=100.0, probe_ok_secs=5.0)
+    t = 1000.0
+    assert br.allow_spawn(t)
+    assert br.record_crash(t) == "closed"
+    assert br.record_crash(t + 1) == "closed"
+    assert br.record_crash(t + 2) == "open"          # 3rd in window
+    assert not br.allow_spawn(t + 50)                # quarantined
+    assert br.allow_spawn(t + 103)                   # quarantine over
+    assert br.state == "half_open"
+    br.spawned(t + 103)
+    assert not br.allow_spawn(t + 104)               # one probe only
+    assert br.record_crash(t + 105) == "open"        # probe died
+    assert not br.allow_spawn(t + 106)
+    assert br.allow_spawn(t + 206)                   # second probe
+    br.spawned(t + 206)
+    br.note_healthy(t + 212)                         # probe survived
+    assert br.state == "closed" and br.restarts == []
+    # the forgotten window really is forgotten: one new crash stays
+    # closed instead of instantly re-tripping
+    assert br.record_crash(t + 300) == "closed"
+
+
+def test_breaker_window_slides():
+    br = CrashLoopBreaker(max_restarts=3, window_secs=10.0)
+    assert br.record_crash(0.0) == "closed"
+    assert br.record_crash(1.0) == "closed"
+    # the first two crashes age out: no trip
+    assert br.record_crash(12.0) == "closed"
+    assert br.record_crash(13.0) == "closed"
+    assert br.record_crash(14.0) == "open"
+
+
+# -- restart / quarantine / alert --------------------------------------------
+
+def test_restart_backoff_quarantine_alert_and_recovery(tmp_path):
+    sup = _sup(tmp_path,
+               breaker_max_restarts=3, breaker_window_secs=60.0,
+               breaker_quarantine_secs=50.0,
+               backoff=BackoffPolicy(retries=10**6, base_secs=0.5,
+                                     factor=2.0, max_secs=30.0,
+                                     jitter=0.25))
+    t = 1000.0
+    sup._scale_up(t)
+    slot = sup.slots[0]
+    br = sup._breaker_of(slot.owner)
+    assert slot.state(br) == "running"
+
+    # crash 1: bounded backoff (deterministic without an rng)
+    slot.handle.die(3)
+    sup._member_tick(slot, t + 1)
+    assert slot.handle is None and slot.restarts == 1
+    assert slot.next_spawn_at == pytest.approx(t + 1.5)
+    sup._member_tick(slot, t + 1.2)                  # still backing off
+    assert slot.handle is None and slot.state(br) == "restarting"
+    sup._member_tick(slot, t + 1.6)                  # respawned
+    assert slot.handle is not None
+
+    # crash 2: backoff doubles
+    slot.handle.die(1)
+    sup._member_tick(slot, t + 2)
+    assert slot.next_spawn_at == pytest.approx(t + 3.0)
+    sup._member_tick(slot, t + 3.1)
+
+    # crash 3 inside the window: breaker OPEN, slot quarantined
+    slot.handle.die(1)
+    sup._member_tick(slot, t + 4)
+    assert br.state == "open"
+    assert slot.state(br) == "quarantined"
+    assert slot.next_spawn_at == 0.0
+    sup._member_tick(slot, t + 20)                   # quarantine holds
+    assert slot.handle is None
+
+    # the status doc + alert ledger + watchtower all carry the page
+    sup._write_status("supervising")
+    doc = json.load(open(sup.status_path))
+    assert doc["kind"] == "supervisor"
+    assert doc["breakers"][slot.owner]["state"] == "open"
+    assert doc["members"][0]["state"] == "quarantined"
+    book = json.load(open(os.path.join(sup.opts.queue_dir, ALERTS_NAME)))
+    entry = book["alerts"][f"supervisor_crash_loop:{slot.owner}"]
+    assert entry["state"] == "firing" and entry["severity"] == "page"
+    fired = [a for a in evaluate([sup.store_base], [sup.opts.queue_dir])
+             if a.rule == "supervisor_crash_loop"]
+    assert len(fired) == 1 and fired[0].subject == slot.owner
+
+    # quarantine expires -> one half-open probe; healthy run closes it
+    sup._member_tick(slot, t + 56)
+    assert slot.handle is not None and br.state == "half_open"
+    sup._member_tick(slot, t + 62)                   # >= probe_ok_secs up
+    assert br.state == "closed" and slot.backoff_i == 0
+    sup._write_status("supervising")
+    book = json.load(open(os.path.join(sup.opts.queue_dir, ALERTS_NAME)))
+    entry = book["alerts"][f"supervisor_crash_loop:{slot.owner}"]
+    assert entry["state"] == "resolved"
+
+
+def test_wedged_heartbeat_is_killed_then_restarted(tmp_path):
+    sup = _sup(tmp_path, stale_secs=10.0)
+    t = 1000.0
+    sup._scale_up(t)
+    slot = sup.slots[0]
+    # a live handle whose status doc heartbeat went silent 20s ago
+    with open(os.path.join(sup.opts.queue_dir,
+                           f"status-{slot.owner}.json"), "w") as f:
+        json.dump({"owner": slot.owner, "pid": 1, "state": "draining",
+                   "heartbeat_at": t - 20}, f)
+    sup._member_tick(slot, t + 15)                   # uptime > stale too
+    assert slot.wedged is True
+    assert slot.handle.signals == [signal.SIGKILL]
+    slot.handle.die(-9)
+    sup._member_tick(slot, t + 16)
+    assert slot.restarts == 1 and slot.wedged is False
+    assert sup.counters["wedged"] == 1
+
+
+def test_clean_exit_is_not_a_crash(tmp_path):
+    sup = _sup(tmp_path)
+    t = 1000.0
+    sup._scale_up(t)
+    slot = sup.slots[0]
+    slot.handle.die(0)
+    sup._member_tick(slot, t + 1)
+    assert slot.restarts == 0 and slot.clean_exits == 1
+    assert sup._breaker_of(slot.owner).restarts == []
+
+
+# -- scaling policy ----------------------------------------------------------
+
+def test_scaling_hysteresis_cooldown_and_poison_suppression(
+        tmp_path, monkeypatch):
+    sup = _sup(tmp_path, min_daemons=1, max_daemons=4,
+               scale_hold_ticks=3, cooldown_secs=10.0)
+    rec = {"n": 1}
+    monkeypatch.setattr(
+        "tenzing_tpu.serve.supervisor.backlog_summary",
+        lambda stores, queues, max_daemons=None: {
+            "recommended_daemons": rec["n"]})
+    t = 1000.0
+    sup._scale_up(t)                                 # the min fill
+    assert sup._active_n() == 1
+
+    # a one-tick spike is hysteresis-filtered
+    rec["n"] = 3
+    sup._scale_tick(t + 1)
+    rec["n"] = 1
+    sup._scale_tick(t + 2)
+    sup._scale_tick(t + 3)
+    sup._scale_tick(t + 4)
+    assert sup._active_n() == 1
+    assert sup.counters["scale_up"] == 1             # the min fill only
+
+    # a persistent desire scales up ONE step per action
+    rec["n"] = 3
+    sup._scale_tick(t + 5)
+    sup._scale_tick(t + 6)
+    sup._scale_tick(t + 7)                           # 3rd hold tick
+    assert sup._active_n() == 2
+    # cooldown gates the next step...
+    sup._scale_tick(t + 8)
+    sup._scale_tick(t + 9)
+    assert sup._active_n() == 2
+    # ...then the still-persistent desire takes the second step
+    sup._scale_tick(t + 18)
+    sup._scale_tick(t + 19)
+    sup._scale_tick(t + 20)
+    assert sup._active_n() == 3
+
+    # poison domination suppresses scale-up
+    rec["n"] = 4
+    monkeypatch.setattr(Supervisor, "_poison_dominated", lambda s: True)
+    for dt in (31, 32, 33, 34):
+        sup._scale_tick(t + dt)
+    assert sup._active_n() == 3
+    assert sup._scaling_state["suppressed_poison"] is True
+    monkeypatch.setattr(Supervisor, "_poison_dominated", lambda s: False)
+
+    # scale-down SIGTERMs the YOUNGEST member
+    rec["n"] = 1
+    youngest = max((s for s in sup.slots.values()), key=lambda s: s.k)
+    for dt in (45, 46, 47):
+        sup._scale_tick(t + dt)
+    assert youngest.stopping is True
+    assert youngest.handle.signals == [signal.SIGTERM]
+    older = [s for s in sup.slots.values() if s is not youngest]
+    assert all(not s.stopping for s in older)
+    # desired never drops below min_daemons
+    assert sup._scaling_state["desired"] >= 1
+
+
+def test_recommendation_is_clamped_by_max_daemons(tmp_path, monkeypatch):
+    sup = _sup(tmp_path, min_daemons=1, max_daemons=2,
+               scale_hold_ticks=1, cooldown_secs=0.0)
+    monkeypatch.setattr(
+        "tenzing_tpu.serve.supervisor.backlog_summary",
+        lambda stores, queues, max_daemons=None: {
+            "recommended_daemons": min(50, max_daemons or 50)})
+    t = 1000.0
+    sup._scale_up(t)
+    for dt in range(1, 6):
+        sup._scale_tick(t + dt)
+    assert sup._active_n() == 2                      # the hard ceiling
+
+
+# -- adoption ----------------------------------------------------------------
+
+def test_adoption_from_live_status_docs(tmp_path):
+    sup = _sup(tmp_path, owner_prefix="fleet")
+    qdir = sup.opts.queue_dir
+    now = time.time()
+    sleeper = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"])
+    try:
+        def _doc(owner, **kw):
+            with open(os.path.join(qdir, f"status-{owner}.json"),
+                      "w") as f:
+                json.dump({"owner": owner, "pid": sleeper.pid,
+                           "state": "draining", "heartbeat_at": now,
+                           "started_at": now - 5, **kw}, f)
+
+        _doc("fleet-0")                              # adoptable
+        _doc("fleet-1", state="stopped")             # said goodbye
+        _doc("fleet-2", heartbeat_at=now - 9999)     # stale heartbeat
+        _doc("fleet-3", pid=2 ** 30)                 # dead pid
+        assert sup._adopt(now) == 1
+        assert sorted(sup.slots) == [0]
+        slot = sup.slots[0]
+        assert slot.adopted is True
+        assert slot.handle.pid == sleeper.pid
+        assert sup.counters["adopted"] == 1
+
+        # the adopted member dying is healed like any other death
+        sleeper.kill()
+        sleeper.wait()
+        sup._member_tick(slot, time.time())
+        assert slot.handle is None and slot.restarts == 1
+    finally:
+        if sleeper.poll() is None:
+            sleeper.kill()
+            sleeper.wait()
+
+
+# -- scale-down under load: zero loss ----------------------------------------
+
+def _stub_member_spawner(drain_secs):
+    """Real in-process DrainDaemons (full lease/claim/status protocol)
+    with a fixed-cost stub drain, duck-typed for the supervisor."""
+    from tenzing_tpu.serve.daemon import DaemonOpts, DrainDaemon
+
+    def runner(item_path, payload, timeout):
+        time.sleep(drain_secs)
+        return {"metric": "stub", "value": 1.0, "unit": "us"}
+
+    class _Handle:
+        def __init__(self, daemon):
+            self._daemon = daemon
+            self.returncode = None
+
+            def go():
+                daemon.run()
+                self.returncode = 0
+
+            self.thread = threading.Thread(target=go, daemon=True)
+            self.thread.start()
+
+        def stop(self):                              # the SIGTERM path
+            self._daemon.stop()
+
+    def spawn(opts, slot):
+        d = DrainDaemon(DaemonOpts(
+            queue_dir=opts.queue_dir, store_path=opts.store_path,
+            owner=slot.owner, handle_signals=False, in_process=True,
+            idle_exit_secs=opts.member_idle_exit_secs or 0.3,
+            poll_secs=0.05, lease_ttl_secs=opts.member_lease_ttl_secs,
+            heartbeat_secs=0.2, backoff_base_secs=0.01),
+            runner=runner, log=lambda m: None)
+        return _Handle(d)
+
+    return spawn
+
+
+def test_scale_down_under_load_loses_nothing(tmp_path):
+    """THE scale-down acceptance: SIGTERM the youngest member while the
+    queue is still draining — every item completes exactly once (the
+    in-flight item is protected by the daemon's own lease protocol),
+    proven by the fleet's status-history audit."""
+    sup = _sup(tmp_path, spawn=_stub_member_spawner(0.3),
+               min_daemons=2, max_daemons=2, tick_secs=0.05,
+               heartbeat_secs=0.5, scale_hold_ticks=10**6,
+               member_idle_exit_secs=0.4, drain_exit=True,
+               max_run_secs=60.0)
+    q = WorkQueue(sup.opts.queue_dir)
+    fps = []
+    for i in range(6):
+        req = DriverRequest(workload="spmv", m=512 + 200 * i)
+        fp = fingerprint_of(req)
+        q.enqueue(fp, req.to_json(), reason="cold")
+        fps.append(fp.exact_digest)
+
+    out = {}
+    th = threading.Thread(
+        target=lambda: out.update(sup.run()), daemon=True)
+    th.start()
+    t0 = time.time()
+    while time.time() - t0 < 20.0:
+        running = [s for s in sup.slots.values()
+                   if s.handle is not None and not s.stopping]
+        if len(running) == 2 and q.leases():
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("two members never started draining")
+    sup._scale_down(time.time())                     # mid-drain SIGTERM
+    th.join(timeout=60.0)
+    assert not th.is_alive(), "supervisor never drained"
+
+    assert out["reason"] == "drained"
+    assert out["double_runs"] == {}
+    assert out["audit_complete"] is True
+    assert out["queue_after"] == 0 and len(q) == 0
+    assert sup.counters["scale_down"] == 1
+    completed = set(out["completed_by"])
+    assert completed == set(fps), "an item was lost in the scale-down"
+    assert all(len(v) == 1 for v in out["completed_by"].values())
+    assert supervisor_exit_code(out) == 0
+
+
+# -- retention GC ------------------------------------------------------------
+
+def test_gc_sweeps_dead_owners_never_live_ones(tmp_path):
+    d = str(tmp_path / "q")
+    os.makedirs(d)
+    now = time.time()
+    old = now - 7200
+
+    def _status(owner, state, hb):
+        with open(os.path.join(d, f"status-{owner}.json"), "w") as f:
+            json.dump({"owner": owner, "state": state,
+                       "heartbeat_at": hb}, f)
+
+    def _aged(path, text="{}"):
+        with open(path, "w") as f:
+            f.write(text)
+        os.utime(path, (old, old))
+
+    _status("dead", "stopped", old)                  # swept
+    _status("gone", "interrupted", old)              # swept
+    _status("fresh", "stopped", now - 10)            # inside retention
+    _status("wedged", "draining", old)               # LIVE evidence
+    _status("keep-0", "stopped", old)                # keep_owners
+    _aged(os.path.join(d, "metrics-dead-0.json"))    # orphaned ring
+    _aged(os.path.join(d, "metrics-dead-1.json"))
+    _aged(os.path.join(d, "metrics-wedged-0.json"))  # owner still live
+    _aged(os.path.join(d, "alerts-dead.json"),
+          json.dumps({"alerts": {"x": {"state": "resolved"}}}))
+    _aged(os.path.join(d, "alerts-loud.json"),
+          json.dumps({"alerts": {"x": {"state": "firing"}}}))
+    os.makedirs(os.path.join(d, "exemplars"))
+    _aged(os.path.join(d, "exemplars", "exemplar-1.jsonl"))
+
+    counts = gc_stale_artifacts([d], retention_secs=3600.0, now=now,
+                                keep_owners=["keep-0"])
+    assert counts == {"status": 2, "metrics": 2, "alerts": 1,
+                      "exemplars": 1}
+    left = sorted(os.listdir(d))
+    assert "status-dead.json" not in left
+    assert "status-gone.json" not in left
+    assert "status-fresh.json" in left               # too young
+    assert "status-wedged.json" in left              # never touch live
+    assert "status-keep-0.json" in left              # pinned
+    assert "metrics-wedged-0.json" in left
+    assert "alerts-loud.json" in left                # still firing
+    assert not os.listdir(os.path.join(d, "exemplars"))
+    # idempotent: a second sweep finds nothing
+    again = gc_stale_artifacts([d], retention_secs=3600.0, now=now,
+                               keep_owners=["keep-0"])
+    assert sum(again.values()) == 0
+
+
+# -- spawner argv (golden) ---------------------------------------------------
+
+def test_member_spawn_argv_golden(tmp_path, monkeypatch):
+    captured = {}
+
+    def fake_popen(cmd, **kw):
+        captured["cmd"], captured["kw"] = cmd, kw
+        raise RuntimeError("captured")
+
+    monkeypatch.setattr(
+        "tenzing_tpu.serve.supervisor.subprocess.Popen", fake_popen)
+    opts = SupervisorOpts(queue_dir="/q", store_path="/s",
+                          listen_socket="/tmp/x.sock",
+                          listen_args=["--busy-poll-us", "50"])
+
+    with pytest.raises(RuntimeError):
+        _subprocess_member_spawn(
+            opts, MemberSlot(k=-1, owner="fleet-listen", kind="listen"))
+    cmd = captured["cmd"]
+    # flags AFTER the subcommand: serve/__main__.py attaches --store/
+    # --queue to each subparser
+    assert cmd[1:4] == ["-m", "tenzing_tpu.serve", "listen"]
+    assert cmd[4:] == ["--store", "/s", "--queue", "/q",
+                       "--socket", "/tmp/x.sock",
+                       "--owner", "fleet-listen", "--busy-poll-us", "50"]
+    assert captured["kw"]["start_new_session"] is True
+
+    # default daemon member: fleet.py's argv with the idle-exit pair
+    # stripped (a supervised member never idle-exits on its own)
+    with pytest.raises(RuntimeError):
+        _subprocess_member_spawn(opts, MemberSlot(k=0, owner="fleet-0"))
+    assert "--idle-exit" not in captured["cmd"]
+    assert "tenzing_tpu.serve.daemon" in captured["cmd"]
+    opts.member_idle_exit_secs = 1.5
+    with pytest.raises(RuntimeError):
+        _subprocess_member_spawn(opts, MemberSlot(k=0, owner="fleet-0"))
+    i = captured["cmd"].index("--idle-exit")
+    assert captured["cmd"][i + 1] == "1.5"
+
+    # the chaos hook substitutes {owner}
+    opts.member_argv = [sys.executable, "-c", "print('{owner}')"]
+    with pytest.raises(RuntimeError):
+        _subprocess_member_spawn(opts, MemberSlot(k=2, owner="fleet-2"))
+    assert captured["cmd"][-1] == "print('fleet-2')"
+
+
+# -- chaos acceptances (real subprocesses) -----------------------------------
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _wait_for(pred, timeout_s, what):
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _sup_cmd(qdir, store, *extra):
+    return [sys.executable, "-m", "tenzing_tpu.serve.supervisor",
+            "--queue", qdir, "--store", store,
+            "--min-daemons", "1", "--max-daemons", "1",
+            "--tick", "0.2", "--heartbeat", "0.3",
+            "--compact-interval", "0", "--gc-interval", "0",
+            "--scale-hold-ticks", "1000000", *extra]
+
+
+def test_chaos_member_sigkill_restart_resume_exactly_once(tmp_path):
+    """THE supervisor chaos acceptance: a member SIGKILLed mid-drain is
+    restarted through backoff; the restarted member reclaims the
+    expired item lease, resumes from the checkpoint journal, and the
+    item's effect lands exactly once — the supervisor drains out rc 0
+    with a clean status-history audit."""
+    qdir = str(tmp_path / "q")
+    store = str(tmp_path / "store.json")
+    q = WorkQueue(qdir)
+    req = DriverRequest(workload="attn", smoke=True, mcts_iters=6,
+                        climb_budget=6, search_iters=2, iters=6,
+                        inject_faults="transient:0.3:7,hang:0.05:11",
+                        inject_hang_secs=1.0, measure_timeout=300.0)
+    fp = fingerprint_of(req)
+    q.enqueue(fp, req.to_json(), reason="cold")
+    ckpt = q.checkpoint_dir_for(fp.exact_digest)
+    jpath = os.path.join(ckpt, "measurements.jsonl")
+
+    proc = subprocess.Popen(
+        _sup_cmd(qdir, store, "--member-lease-ttl", "2",
+                 "--member-heartbeat", "0.3", "--member-poll", "0.2",
+                 "--member-idle-exit", "1.0", "--backoff-base", "0.3",
+                 "--drain-exit"),
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        def _journal_lines():
+            if not os.path.exists(jpath):
+                return 0
+            with open(jpath) as f:
+                return sum(1 for line in f if line.strip())
+
+        member = _wait_for(
+            lambda: _read_json(os.path.join(qdir, "status-fleet-0.json")),
+            60.0, "the member's status doc")
+        prior = _wait_for(lambda: _journal_lines() >= 2, 300.0,
+                          "two journaled measurements") and \
+            _journal_lines()
+        # SIGKILL the member's whole session: daemon AND drain child
+        # die with no chance to release the lease or flush anything
+        os.killpg(int(member["pid"]), signal.SIGKILL)
+        out, err = proc.communicate(timeout=560)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, err[-2000:]
+    summary = json.loads(out.splitlines()[-1])
+    assert summary["reason"] == "drained"
+    assert summary["counters"]["restarts"] == 1
+    assert summary["double_runs"] == {} and summary["audit_complete"]
+    assert summary["queue_after"] == 0 and len(q) == 0
+    # the restarted member really resumed the dead one's journal
+    log = open(os.path.join(ckpt, "drain.log")).read()
+    resumes = [line for line in log.splitlines()
+               if line.startswith("resume: ")]
+    assert resumes, "the restarted drain must resume from the journal"
+    assert int(resumes[-1].split()[1]) >= prior >= 2
+    verdict = json.load(open(os.path.join(ckpt, "verdict.json")))
+    assert verdict["fault"]["resumed"] is True
+
+
+def test_chaos_supervisor_sigkill_successor_adopts(tmp_path):
+    """Supervisor SIGKILL-survivability: the successor adopts the
+    still-running member from its live status doc (zero double-spawns),
+    a third contender is excluded by the controller lease (rc 3), and
+    shutdown reaps the adopted member."""
+    qdir = str(tmp_path / "q")
+    store = str(tmp_path / "store")
+    os.makedirs(store)
+
+    a = subprocess.Popen(
+        _sup_cmd(qdir, store, "--owner", "supA", "--lease-ttl", "1.5",
+                 "--member-heartbeat", "0.3"),
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        text=True)
+    b = c = None
+    member = None
+    try:
+        member = _wait_for(
+            lambda: _read_json(os.path.join(qdir, "status-fleet-0.json")),
+            60.0, "the member's status doc")
+        member_pid = int(member["pid"])
+        _wait_for(lambda: (_read_json(
+            os.path.join(qdir, "status-supervisor.json")) or {}
+        ).get("owner") == "supA", 30.0, "supA's heartbeat")
+        a.send_signal(signal.SIGKILL)                # no goodbye
+        a.wait()
+        os.kill(member_pid, 0)                       # member survived
+        time.sleep(1.8)                              # age the lease
+
+        b = subprocess.Popen(
+            _sup_cmd(qdir, store, "--owner", "supB", "--lease-ttl",
+                     "1.5", "--member-heartbeat", "0.3",
+                     "--max-run-secs", "6"),
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        _wait_for(lambda: (_read_json(
+            os.path.join(qdir, "status-supervisor.json")) or {}
+        ).get("owner") == "supB", 30.0, "supB's takeover heartbeat")
+        # a third contender is excluded while B holds the lease
+        c = subprocess.run(
+            _sup_cmd(qdir, store, "--owner", "supC",
+                     "--max-run-secs", "1"),
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert c.returncode == 3, c.stdout + c.stderr
+        assert json.loads(c.stdout.splitlines()[-1])["reason"] == \
+            "lease_held"
+
+        bout, berr = b.communicate(timeout=60)
+        assert b.returncode == 0, berr[-2000:]
+        summary = json.loads(bout.splitlines()[-1])
+        assert summary["reason"] == "max_run_secs"
+        assert summary["counters"]["adopted"] == 1
+        assert summary["counters"].get("spawned", 0) == 0, \
+            "adoption must not double-spawn"
+        assert summary["members"]["fleet-0"]["adopted"] is True
+        # shutdown reaped the adopted member
+        _wait_for(lambda: not _pid_alive(member_pid), 30.0,
+                  "the adopted member to be reaped")
+    finally:
+        for p in (a, b):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.communicate()
+        if member is not None and _pid_alive(int(member["pid"])):
+            try:
+                os.killpg(int(member["pid"]), signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def test_chaos_crash_loop_member_ends_quarantined_rc1(tmp_path):
+    """A member that exits 3 on every spawn trips the breaker: the run
+    drains out degraded — breaker open, ``supervisor_crash_loop``
+    firing in the ledger, rc 1."""
+    qdir = str(tmp_path / "q")
+    store = str(tmp_path / "store")
+    os.makedirs(qdir)
+    os.makedirs(store)
+    r = subprocess.run(
+        _sup_cmd(qdir, store, "--tick", "0.05", "--heartbeat", "0.1",
+                 "--backoff-base", "0.05", "--backoff-max", "0.1",
+                 "--breaker-max-restarts", "2", "--breaker-window", "60",
+                 "--breaker-quarantine", "300", "--drain-exit",
+                 "--member-argv", json.dumps(
+                     [sys.executable, "-c", "import sys; sys.exit(3)"])),
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    summary = json.loads(r.stdout.splitlines()[-1])
+    assert summary["breakers"]["fleet-0"]["state"] == "open"
+    assert summary["counters"]["quarantined"] == 1
+    assert summary["counters"]["restarts"] == 2
+    assert summary["double_runs"] == {}
+    book = json.load(open(os.path.join(qdir, ALERTS_NAME)))
+    entry = book["alerts"]["supervisor_crash_loop:fleet-0"]
+    assert entry["state"] == "firing"
+    assert supervisor_exit_code(summary) == 1
