@@ -57,6 +57,35 @@ from tenzing_tpu.utils.atomic import atomic_dump_json  # noqa: F401 — re-expor
 
 CHECKPOINT_VERSION = 1
 
+# the drain daemon wires its lease's fencing token to the checkpoint
+# journal through the environment (`<lease-path>:<epoch>`): the drain —
+# in-process or a --exec-item subprocess — then refuses to append
+# journal lines once a rival claim supersedes the lease (serve/lease.py
+# "Epoch fencing"), so a zombie holder cannot interleave stale rows into
+# the successor's journal
+FENCE_ENV = "TENZING_FENCE"
+
+
+def _fence_from_env():
+    """The env-wired fence check (see :data:`FENCE_ENV`); None when no
+    fence is declared.  Parsed lazily per checkpoint object — the daemon
+    sets the variable around each drained item."""
+    spec = os.environ.get(FENCE_ENV)
+    if not spec or ":" not in spec:
+        return None
+    path, _, epoch_s = spec.rpartition(":")
+    try:
+        epoch = int(epoch_s)
+    except ValueError:
+        return None
+
+    def check() -> None:
+        from tenzing_tpu.serve.lease import check_epoch
+
+        check_epoch(path, epoch)
+
+    return check
+
 # journal provenance tags: only MEASURED rows restore into the cache
 PROVENANCE_MEASURED = "measured"
 PROVENANCE_DEGRADED = "degraded"
@@ -122,13 +151,23 @@ def _result_from_json(j: Dict[str, Any]) -> BenchResult:
 
 
 class SearchCheckpoint:
-    """One checkpoint directory (see module docstring)."""
+    """One checkpoint directory (see module docstring).  ``fence`` is an
+    optional zero-arg callable raising
+    :class:`~tenzing_tpu.fault.errors.FencedWriteError` when this
+    writer's lease has been superseded — checked before every journal
+    append and state snapshot; defaults to the daemon's env-wired token
+    (:data:`FENCE_ENV`), None when unfenced."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, fence=None):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         self._journal_f = None
         self._state: Dict[str, Any] = {}
+        self._fence = fence if fence is not None else _fence_from_env()
+
+    def _check_fence(self) -> None:
+        if self._fence is not None:
+            self._fence()
 
     # -- paths -------------------------------------------------------------
     @property
@@ -155,6 +194,7 @@ class SearchCheckpoint:
             "result": res.to_json(),
             "ops": sequence_to_json(order),
         }, sort_keys=True)
+        self._check_fence()
         if self._journal_f is None:
             self._journal_f = open(self.journal_path, "a")
         self._journal_f.write(line + "\n")
@@ -172,6 +212,7 @@ class SearchCheckpoint:
             "batch": {"ids": list(ids), "seed": seed,
                       "opts": _opts_key(opts), "times": times},
         }, sort_keys=True)
+        self._check_fence()
         if self._journal_f is None:
             self._journal_f = open(self.journal_path, "a")
         self._journal_f.write(line + "\n")
@@ -272,7 +313,20 @@ class SearchCheckpoint:
         if state is not None:
             self._state = dict(state)
         self._state.update(merge)
-        atomic_write_json(self.state_path, self._state)
+        self._check_fence()
+        # transient EIO retries in-process through THE shared backoff
+        # (same rule as store writes): a failed cursor snapshot would
+        # otherwise fail the whole drain attempt, and a restarted
+        # member replays the identical injected-fault schedule — the
+        # item would poison on a bounded burst instead of outliving it
+        from tenzing_tpu.fault.backoff import BackoffPolicy, retry_call
+        from tenzing_tpu.fault.errors import is_transient_io
+
+        retry_call(
+            lambda: atomic_write_json(self.state_path, self._state),
+            policy=BackoffPolicy(retries=4, base_secs=0.05, factor=2.0,
+                                 max_secs=0.5),
+            retry_on=is_transient_io, where="fault.checkpoint.state")
 
     def load_state(self) -> Optional[Dict[str, Any]]:
         """The last snapshot, digest-verified; None when absent."""

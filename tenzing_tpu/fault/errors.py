@@ -29,6 +29,8 @@ candidate, while mis-classifying a deterministic as transient costs
 
 from __future__ import annotations
 
+import errno as _errno
+
 
 class FaultClass:
     """The three failure classes, ordered by severity (the rank-agreement
@@ -83,6 +85,45 @@ class DeviceLostError(RuntimeError):
     """The device is unrecoverable; escalate (degrade or abort)."""
 
 
+class FencedWriteError(RuntimeError):
+    """A write was rejected by the lease epoch fence (serve/lease.py): a
+    rival claim with a newer epoch exists, so this holder is a zombie —
+    reclaimed during a stall on a coarse/skewed-mtime filesystem — and
+    its write would be stale.  Classified transient (the item is in
+    better hands, never evidence against the request), but the daemon
+    treats it specially: abandon, don't retry, don't poison."""
+
+
+class StoreReadonlyError(TransientError):
+    """The schedule store is latched read-only (ENOSPC/EROFS/quota —
+    serve/store.py ``store_readonly``): cold/near resolution would need
+    a durable write that cannot land.  Transient by nature — space comes
+    back, the latch clears on a successful probe — so shed-and-retry-later
+    is the designed response (serve/listen.py's ``store_readonly`` shed)."""
+
+
+# errno values that mean "the filesystem will not take more bytes" — not
+# a flake, not worth millisecond-scale retries: latch read-only instead
+_UNWRITABLE_ERRNOS = frozenset(
+    getattr(_errno, name) for name in ("ENOSPC", "EDQUOT", "EROFS")
+    if hasattr(_errno, name))
+
+
+def is_unwritable_io(exc: BaseException) -> bool:
+    """True iff ``exc`` is the full-disk family of OSError (ENOSPC /
+    EDQUOT / EROFS): retrying on a backoff timescale cannot help, the
+    store must degrade to read-only until a probe write succeeds."""
+    return (isinstance(exc, OSError)
+            and getattr(exc, "errno", None) in _UNWRITABLE_ERRNOS)
+
+
+def is_transient_io(exc: BaseException) -> bool:
+    """The retry predicate for hardened storage writers (THE shared
+    fault/backoff.py): plain I/O flakes (EIO and friends) retry;
+    the unwritable family does not (see :func:`is_unwritable_io`)."""
+    return isinstance(exc, OSError) and not is_unwritable_io(exc)
+
+
 # message fragments checked lowercase; order matters only across lists
 # (device-lost checked first: "device lost while connection reset" is a loss)
 _DEVICE_LOST_PATTERNS = (
@@ -126,6 +167,10 @@ def classify_error(exc: BaseException) -> str:
     """Map an exception to a :class:`FaultClass` string (see module doc)."""
     if isinstance(exc, DeviceLostError):
         return FaultClass.DEVICE_LOST
+    if isinstance(exc, FencedWriteError):
+        # a zombie's rejected write is never evidence against the
+        # request — the rival that fenced us is draining it right now
+        return FaultClass.TRANSIENT
     if isinstance(exc, DeterministicScheduleError):
         return FaultClass.DETERMINISTIC
     if isinstance(exc, TransientError):

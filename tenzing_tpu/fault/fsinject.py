@@ -1,0 +1,278 @@
+"""Seeded hostile-filesystem fault injection: the storage-plane chaos
+substrate.
+
+``fault/inject.py`` injects at the *benchmark* layer; this module
+injects at the *filesystem* layer — the failure modes a shared (NFS-like)
+mount actually exhibits, threaded through THE one atomic-write seam
+(utils/atomic.py) every store/segment/reqlog/checkpoint/lease/status
+writer already funnels through.  Six kinds
+(``TENZING_FSINJECT=kind:rate:seed[:param]``, comma-separated to
+compose):
+
+* ``eio`` — raises ``OSError(EIO)`` on a write or fsync (flaky disk /
+  dropped NFS RPC).  Classified transient (fault/errors.py), so the
+  hardened writers retry through THE shared fault/backoff.py.
+* ``enospc`` — raises ``OSError(ENOSPC)`` on a write (full disk /
+  exhausted quota).  Not retryable on any useful timescale: the serve
+  plane degrades to read-only (docs/robustness.md "Disaster recovery").
+* ``torn_rename`` — dies (SIGKILL) between the fsynced temp file and
+  the link/replace that publishes it: the classic torn-publish crash the
+  sealed formats are built to survive.  ``param=1`` raises
+  :class:`InjectedTornRename` instead of dying (for in-process tests).
+* ``stale_read`` — a read of a just-replaced file returns the
+  *previous* complete content, once (NFS attribute-cache staleness).
+  The lease protocol's nonce re-read is the correctness-critical
+  consumer — this is the lie epoch fencing exists to survive.
+* ``mtime_skew`` — observed lease mtimes shift ``param`` seconds into
+  the past (default 2.0): a skewed client clock ages a live rival's
+  heartbeat, the premature-reclaim hole.
+* ``mtime_coarse`` — observed lease mtimes floor to ``param``-second
+  granularity (default 1.0): FAT/NFSv2-style coarse timestamps, the
+  same hole by truncation.
+
+Draws are **identity-keyed**, mirroring inject.py: each checked op draws
+from ``hash(kind:seed:basename:op-counter)`` — per-(kind, file) counters,
+not process RNG — so the same write to the same file fails across
+restarts, and a chaos run replays under its seed.  For ``eio`` /
+``enospc`` / ``stale_read``, an integer ``param`` bounds total fires
+(0 = unlimited): a burst-then-recover schedule, which is how the
+``store_unwritable`` fire-then-resolve drill is scripted.  Counters
+restart with the process, like inject.py's — a restarted member replays
+its own fault schedule from the top.
+
+Install in-process with :func:`install`, or export ``TENZING_FSINJECT``
+before spawning: utils/atomic.py lazily installs from the environment on
+first write, so every subprocess fleet member (supervisor, daemons,
+drain children) inherits the hostile filesystem without argv plumbing.
+The fencing epoch registry (serve/lease.py — O_EXCL directory entries,
+not file content) is deliberately outside the seam: it is the layer the
+chaos must not be able to lie to.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from tenzing_tpu.fault.inject import _hash_draw
+from tenzing_tpu.utils import atomic as _atomic
+
+FS_KINDS = ("eio", "enospc", "torn_rename", "stale_read", "mtime_skew",
+            "mtime_coarse")
+# which seam ops each fault kind can fire on (utils/atomic.py checkpoints)
+_OPS_OF = {
+    "eio": ("write", "fsync"),
+    "enospc": ("write",),
+    "torn_rename": ("link", "replace"),
+}
+FSINJECT_ENV = _atomic.FSINJECT_ENV
+
+
+class InjectedTornRename(OSError):
+    """The raise-mode torn rename (``torn_rename`` with ``param=1``):
+    the publish step failed after the temp bytes landed.  An OSError so
+    the classifier calls it transient — the caller's retry re-publishes."""
+
+    def __init__(self, msg: str):
+        super().__init__(errno.EIO, msg)
+
+
+@dataclass(frozen=True)
+class FsInjectSpec:
+    """One filesystem-fault channel: ``kind`` at probability ``rate``
+    from ``seed``; ``param`` is per-kind (module docstring)."""
+
+    kind: str
+    rate: float
+    seed: int
+    param: float = 0.0
+
+
+def parse_fs_specs(text: str) -> List[FsInjectSpec]:
+    """Parse ``kind:rate:seed[:param]`` (comma-separated).  Errors are
+    loud, same rule as inject.py: a typo'd chaos spec silently injecting
+    nothing would make a green hostile-fs run meaningless."""
+    specs: List[FsInjectSpec] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) not in (3, 4):
+            raise ValueError(
+                f"fsinject spec {part!r}: want kind:rate:seed[:param]")
+        kind, rate_s, seed_s = fields[:3]
+        if kind not in FS_KINDS:
+            raise ValueError(
+                f"fsinject kind {kind!r}: want one of {FS_KINDS}")
+        rate = float(rate_s)
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"fsinject rate {rate!r} not in [0, 1]")
+        param = float(fields[3]) if len(fields) == 4 else 0.0
+        specs.append(FsInjectSpec(kind=kind, rate=rate, seed=int(seed_s),
+                                  param=param))
+    if not specs:
+        raise ValueError("fsinject: empty spec")
+    return specs
+
+
+def format_fs_specs(specs: List[FsInjectSpec]) -> str:
+    """The env-var form of ``specs`` (inverse of :func:`parse_fs_specs`)
+    — what a chaos harness exports before spawning its fleet."""
+    parts = []
+    for s in specs:
+        part = f"{s.kind}:{s.rate}:{s.seed}"
+        if s.param:
+            part += f":{s.param:g}"
+        parts.append(part)
+    return ",".join(parts)
+
+
+class FsInjectBackend:
+    """The injectable I/O backend utils/atomic.py consults (see module
+    docstring).  ``injected`` counts fires per kind — chaos tests assert
+    on it to prove the run actually exercised the fault paths."""
+
+    def __init__(self, specs: List[FsInjectSpec]):
+        self.specs = list(specs)
+        self.injected: Dict[str, int] = {k: 0 for k in FS_KINDS}
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, str], int] = {}
+        self._fires: Dict[int, int] = {}   # spec index -> fires so far
+        self._prev: Dict[str, str] = {}    # path -> pre-replace content
+        self._snapshot = any(s.kind == "stale_read" for s in self.specs)
+
+    # -- draw machinery ------------------------------------------------------
+    def _draw(self, spec: FsInjectSpec, idx: int, base: str) -> bool:
+        """One identity-keyed coin flip; counts the (kind, file) op and
+        honors the channel's max-fires bound."""
+        with self._lock:
+            n = self._counters.get((spec.kind, base), 0)
+            self._counters[(spec.kind, base)] = n + 1
+            if spec.param and spec.kind in ("eio", "enospc", "stale_read") \
+                    and self._fires.get(idx, 0) >= int(spec.param):
+                return False  # channel burst exhausted: quiet from here on
+            if _hash_draw(f"{spec.kind}:{spec.seed}:{base}:{n}") >= spec.rate:
+                return False
+            self._fires[idx] = self._fires.get(idx, 0) + 1
+        self._record(spec.kind, base)
+        return True
+
+    def _record(self, kind: str, base: str) -> None:
+        self.injected[kind] += 1
+        try:
+            from tenzing_tpu.obs.metrics import get_metrics
+            from tenzing_tpu.obs.tracer import get_tracer
+
+            get_metrics().counter(f"fault.fsinjected.{kind}").inc()
+            tr = get_tracer()
+            if tr.enabled:
+                tr.event("fault.fsinjected", kind=kind, file=base)
+        except Exception:
+            pass  # telemetry must never turn an injected fault into a real one
+
+    # -- seam checkpoints (utils/atomic.py) ----------------------------------
+    def check(self, op: str, path: str) -> None:
+        """The write-path checkpoint: ``op`` is about to run against the
+        (final) ``path``.  May raise OSError(EIO/ENOSPC), raise
+        :class:`InjectedTornRename`, or SIGKILL this process."""
+        base = os.path.basename(path)
+        if self._snapshot and op in ("link", "replace"):
+            self._snapshot_prev(path)
+        for idx, spec in enumerate(self.specs):
+            if op not in _OPS_OF.get(spec.kind, ()):
+                continue
+            if not self._draw(spec, idx, base):
+                continue
+            if spec.kind == "torn_rename":
+                if spec.param:
+                    raise InjectedTornRename(
+                        f"injected torn rename (fsinject {base})")
+                # the real thing: die with the temp bytes on disk and the
+                # publish not yet linked — the successor finds the torn state
+                os.kill(os.getpid(), signal.SIGKILL)
+            code = errno.ENOSPC if spec.kind == "enospc" else errno.EIO
+            raise OSError(code, f"injected {spec.kind} (fsinject {base} "
+                                f"op {op})")
+
+    def _snapshot_prev(self, path: str) -> None:
+        """Remember the content a replace is about to supersede — the
+        stale version a later injected read will serve."""
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            return  # first publish: nothing older to serve stale
+        with self._lock:
+            self._prev[path] = text
+
+    def maybe_stale_json(self, path: str) -> Optional[Any]:
+        """The read-path checkpoint: the previous complete JSON content
+        of ``path``, served at most once per superseded version, when a
+        ``stale_read`` draw fires — else None (read the real file)."""
+        import json
+
+        if not self._snapshot or path not in self._prev:
+            return None
+        base = os.path.basename(path)
+        for idx, spec in enumerate(self.specs):
+            if spec.kind != "stale_read":
+                continue
+            if not self._draw(spec, idx, base):
+                continue
+            with self._lock:
+                text = self._prev.pop(path, None)
+            if text is None:
+                return None
+            try:
+                return json.loads(text)
+            except ValueError:
+                return None  # stale version was torn: the real read decides
+        return None
+
+    def observe_mtime(self, path: str, mtime: float) -> float:
+        """The clock checkpoint: what a lease-expiry check *observes* for
+        ``path``'s mtime — skewed and/or coarsened when draws fire."""
+        base = os.path.basename(path)
+        out = mtime
+        for idx, spec in enumerate(self.specs):
+            if spec.kind == "mtime_coarse":
+                if self._draw(spec, idx, base):
+                    gran = spec.param or 1.0
+                    out = (out // gran) * gran
+            elif spec.kind == "mtime_skew":
+                if self._draw(spec, idx, base):
+                    out -= (spec.param or 2.0)
+        return out
+
+
+def install(specs: Union[str, List[FsInjectSpec]]) -> FsInjectBackend:
+    """Install a hostile-filesystem backend behind utils/atomic.py's
+    seam; returns it (tests assert on ``backend.injected``)."""
+    if isinstance(specs, str):
+        specs = parse_fs_specs(specs)
+    backend = FsInjectBackend(specs)
+    _atomic.set_io_backend(backend)
+    return backend
+
+
+def uninstall() -> None:
+    """Restore the well-behaved filesystem."""
+    _atomic.set_io_backend(None)
+
+
+def installed() -> Optional[FsInjectBackend]:
+    return _atomic.io_backend()
+
+
+def install_from_env() -> Optional[FsInjectBackend]:
+    """Install from ``$TENZING_FSINJECT`` (the subprocess-inheritance
+    path — utils/atomic.py calls this lazily on first write)."""
+    text = os.environ.get(FSINJECT_ENV)
+    if not text:
+        return None
+    return install(text)

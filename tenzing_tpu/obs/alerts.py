@@ -105,6 +105,17 @@ DEFAULT_RULES: Dict[str, Dict[str, Any]] = {
     # fired from the supervisor's status-doc breaker block: a member
     # slot crash-looped past its restart budget and sits quarantined
     "supervisor_crash_loop": {"enabled": True, "severity": "page"},
+    # the serve plane latched a store read-only (ENOSPC/EDQUOT/EROFS):
+    # exact answers continue, near/cold tiers shed, the daemon pauses
+    # claims.  Fires while the latch doc rides the status/snapshot
+    # docs; resolves (via the ledger's hold) once a probe write lands
+    # and the latch clears
+    "store_unwritable": {"enabled": True, "severity": "page"},
+    # segment damage economics: checksum-skip / quarantine counters
+    # growing across the snapshot ring (0 = any growth fires) — the
+    # store is taking damage faster than anyone runs fsck
+    "store_damage_rate": {"enabled": True, "severity": "ticket",
+                          "max_damage": 0},
 }
 
 
@@ -235,6 +246,24 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
         r = rules.get(name) or {}
         return r if r.get("enabled", True) else None
 
+    # the latch doc appears on BOTH the owner's status doc and its
+    # metric snapshots; one alert per owner, whichever surfaced first
+    ro_fired: set = set()
+
+    def ro_alert(owner: str, ro: Dict[str, Any],
+                 r: Dict[str, Any]) -> None:
+        if owner in ro_fired:
+            return
+        ro_fired.add(owner)
+        alerts.append(Alert(
+            "store_unwritable", owner, r["severity"],
+            {"errno": ro.get("errno"), "reason": ro.get("reason")},
+            None,
+            f"store latched read-only ({ro.get('error', '?')}): exact "
+            "answers continue, near/cold tiers shed, claims pause — "
+            "clears when a probe write lands (free space / fix the "
+            "mount)"))
+
     for d in list(store_dirs) + list(queue_dirs):
         if not os.path.isdir(d):
             raise AlertTreeError(f"fleet tree: {d} is not a directory")
@@ -347,6 +376,52 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
                     f"({tr.get('dropped_spans', 0)} spans / "
                     f"{tr.get('dropped_events', 0)} events): telemetry "
                     "is being lost"))
+            r = on("store_unwritable")
+            ro = latest.get("store_readonly")
+            if r and isinstance(ro, dict):
+                ro_alert(owner, ro, r)
+            r = on("store_damage_rate")
+            if r:
+                # segment-damage growth across the ring: the same
+                # reset-tolerant delta trick as tenant_shed, over the
+                # store's checksum-skip / quarantine counters
+                def damage_ctr(doc: Dict[str, Any], key: str) -> int:
+                    c = (doc.get("metrics") or {}).get("counters") or {}
+                    try:
+                        return int(c.get(key, 0))
+                    except (TypeError, ValueError):
+                        return 0
+
+                damage, detail = 0, {}
+                for key in ("serve.store.checksum_failed",
+                            "serve.store.segment_quarantined",
+                            "serve.store.manifest_quarantined"):
+                    new = damage_ctr(docs[-1], key)
+                    old = damage_ctr(docs[0], key)
+                    delta = (new - old) if new >= old else new
+                    if delta > 0:
+                        detail[key.rsplit(".", 1)[-1]] = delta
+                        damage += delta
+                if damage > r["max_damage"]:
+                    alerts.append(Alert(
+                        "store_damage_rate", owner, r["severity"],
+                        detail, r["max_damage"],
+                        f"{damage} damaged store record(s) across the "
+                        f"snapshot ring (window of {len(docs)}): "
+                        f"{detail} — run `serve fsck` and check the "
+                        "disk before the manifest rots further"))
+
+    r = on("store_unwritable")
+    if r:
+        # daemons surface the latch on their status doc only (they
+        # publish no snapshot ring) — catch those here
+        for st in seen_status:
+            if st.get("state") == "stopped":
+                continue
+            ro = st.get("store_readonly")
+            if isinstance(ro, dict):
+                ro_alert(str(st.get("owner", st.get("_file", "?"))),
+                         ro, r)
 
     r = on("stale_heartbeat")
     if r:
@@ -461,8 +536,11 @@ def backlog_summary(store_dirs: List[str],
     ``max_daemons`` (``None`` = ~os.cpu_count(); ``0`` = unclamped —
     the raw figure stays in ``recommended_daemons_raw``) so one burst
     against a slow drain cannot recommend an absurd fleet for the
-    host.  Read-only and damage-tolerant: unreadable pieces contribute
-    zero, never raise."""
+    host.  Member slots quarantined by a live supervisor's crash-loop
+    breakers are excluded from capacity (their stale status docs would
+    otherwise inflate it) and reported as ``quarantined_daemons``.
+    Read-only and damage-tolerant: unreadable pieces contribute zero,
+    never raise."""
     import math
 
     from tenzing_tpu.obs.metrics import snapshot_history
@@ -508,6 +586,7 @@ def backlog_summary(store_dirs: List[str],
 
     drain = 0.0
     daemons = 0
+    quarantined = 0
     walls: List[float] = []
     for qd in dict.fromkeys(queue_dirs):
         if not os.path.isdir(qd):
@@ -516,12 +595,29 @@ def backlog_summary(store_dirs: List[str],
             docs = _status_docs(qd)
         except OSError:
             continue
+        # a live supervisor's open/half-open breakers name quarantined
+        # member slots: a crash-looped member leaves a stale (never
+        # "stopped") status doc behind, which must not count as drain
+        # capacity — or recommended_daemons under-recommends exactly
+        # while the fleet is degraded
+        bad_members = set()
+        for st in docs:
+            if st.get("kind") != "supervisor" or \
+                    st.get("state") == "stopped":
+                continue
+            for member, b in (st.get("breakers") or {}).items():
+                if isinstance(b, dict) and \
+                        b.get("state") in ("open", "half_open"):
+                    bad_members.add(str(member))
         for st in docs:
             # only drain daemons count toward fleet capacity — the
             # serve loop and the supervisor publish the same status
             # shape but drain nothing
             if st.get("kind") in ("serve_loop", "supervisor") or \
                     st.get("state") == "stopped":
+                continue
+            if str(st.get("owner", "")) in bad_members:
+                quarantined += 1
                 continue
             ws = []
             for h in st.get("history") or []:
@@ -555,7 +651,8 @@ def backlog_summary(store_dirs: List[str],
         else min(recommended, int(max_daemons))
     return {"arrival_per_s": round(arrival, 3),
             "drain_per_s": round(drain, 3),
-            "daemons": daemons, "depth": depth,
+            "daemons": daemons, "quarantined_daemons": quarantined,
+            "depth": depth,
             "per_item_s": round(per_item_s, 3) if per_item_s else None,
             "recommended_daemons": clamped,
             "recommended_daemons_raw": recommended,

@@ -952,6 +952,51 @@ def _slo_line(slo: Dict[str, Any]) -> str:
     return ", ".join(bits)
 
 
+def dr_lines(store_dir: str, now: float) -> List[str]:
+    """The disaster-recovery posture of one store directory
+    (docs/robustness.md "Disaster recovery"): the last ``serve fsck
+    --stamp`` verdict and the backup-generation census — rendered so a
+    follow screen answers "when did anyone last prove this store clean,
+    and how far back could we restore?" without running either tool."""
+    from tenzing_tpu.serve import dr
+
+    lines: List[str] = []
+    stamps = [os.path.join(store_dir, dr.FSCK_STAMP)]
+    stamps += sorted(_glob.glob(os.path.join(store_dir, "*.fsck.json")))
+    for sp in stamps:
+        try:
+            with open(sp) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("kind") != "fsck":
+            continue
+        lines.append(
+            f"fsck   {doc.get('store', store_dir)}: "
+            f"{'clean' if doc.get('ok') else 'DAMAGED'} (rc "
+            f"{doc.get('rc', '?')}), {doc.get('records', 0)} record(s), "
+            f"{len(doc.get('errors') or [])} error(s) / "
+            f"{len(doc.get('warnings') or [])} warning(s), stamped "
+            f"{_age(doc, 'checked_at', now)} ago")
+    root = dr.backups_root(store_dir)
+    try:
+        gens = dr.list_generations(root)
+    except OSError:
+        gens = []
+    if gens:
+        latest = os.path.join(root, gens[-1])
+        try:
+            cat = dr.load_catalog(latest)
+            detail = (f"{len(cat.get('files') or [])} file(s), "
+                      f"{_age(cat, 'created_at', now)} ago")
+        except dr.DrError as e:
+            detail = f"catalog unreadable: {e}"
+        lines.append(
+            f"backup {store_dir}: {len(gens)} generation(s), latest "
+            f"`{os.path.basename(latest)}` ({detail})")
+    return lines
+
+
 def fleet_lines(store_dirs: List[str],
                 queue_dirs: List[str]) -> List[str]:
     """One render of the live fleet (docs/observability.md "Fleet
@@ -987,9 +1032,12 @@ def fleet_lines(store_dirs: List[str],
             total = sum(served.values()) or 1
             mix = "/".join(f"{t}:{n} ({100 * n // total}%)"
                            for t, n in served.items())
+            ro = st.get("store_readonly")
             lines.append(
-                f"serve  {st.get('owner', name)}: {st.get('state')}, "
-                f"hb {_age(st, 'heartbeat_at', now)} ago, queue "
+                f"serve  {st.get('owner', name)}: {st.get('state')}"
+                + (" [STORE READONLY — exact only, near/cold shed]"
+                   if ro else "")
+                + f", hb {_age(st, 'heartbeat_at', now)} ago, queue "
                 f"{st.get('queue_depth', 0)} (+{st.get('in_flight', 0)} "
                 f"in flight), shed {c.get('shed', 0)}, timeouts "
                 f"{c.get('timeouts', 0)}, mix {mix}")
@@ -1018,6 +1066,8 @@ def fleet_lines(store_dirs: List[str],
                         f"({rl.get('bytes', 0)}B, "
                         f"{rl.get('buffered', 0)} buffered, "
                         f"{rl.get('dropped_sampling', 0)} sampled out)")
+        # disaster-recovery posture: last fsck verdict + backup census
+        lines += dr_lines(d, now)
     for qd in queue_dirs:
         if not os.path.isdir(qd):
             lines.append(f"queue  {qd}: missing directory")
@@ -1081,8 +1131,10 @@ def fleet_lines(store_dirs: List[str],
             c = st.get("counters", {})
             item = st.get("item") or {}
             lines.append(
-                f"daemon {st.get('owner', name)}: {st.get('state')}, "
-                f"hb {_age(st, 'heartbeat_at', now)} ago, claimed "
+                f"daemon {st.get('owner', name)}: {st.get('state')}"
+                + (" [STORE READONLY — claims paused]"
+                   if st.get("store_readonly") else "")
+                + f", hb {_age(st, 'heartbeat_at', now)} ago, claimed "
                 f"{c.get('claimed', 0)}, completed {c.get('completed', 0)}"
                 f", retried {c.get('retried', 0)}, poisoned "
                 f"{c.get('poisoned', 0)}"

@@ -22,6 +22,11 @@ same machine-readable discipline as the bench driver):
 * ``compact`` — one offline compaction pass over a **segmented** store
   directory (serve/segments.py): merge multi-segment buckets, adopt
   orphans, reclaim — crash-consistent, lease-exclusive.
+* ``backup`` / ``restore`` / ``fsck`` — disaster recovery
+  (serve/dr.py, docs/robustness.md "Disaster recovery"): point-in-time
+  hard-linked generations with a checksummed catalog, superset-safe
+  merge-restore, and a deep read-only integrity walk whose exit code
+  CI gates on (0 clean / 1 damaged / 2 unreadable).
 
 ``--store`` accepts both backends: a ``*.json`` path is the legacy
 monolithic store, anything else a segmented store directory
@@ -220,12 +225,78 @@ def main(argv: Optional[List[str]] = None) -> int:
     pc.add_argument("--crash-after", choices=("segment", "manifest"),
                     default=None, help=argparse.SUPPRESS)
 
+    pb = sub.add_parser("backup",
+                        help="one point-in-time backup generation "
+                             "(docs/robustness.md 'Disaster recovery')")
+    pb.add_argument("--store", required=True,
+                    help="store path (segmented directory or *.json)")
+    pb.add_argument("--out", default=None, metavar="DIR",
+                    help="generations root (default <store>/backups)")
+    pb.add_argument("--note", default="",
+                    help="free-form tag stamped into the catalog")
+
+    pr = sub.add_parser("restore",
+                        help="catalog-verified point-in-time restore "
+                             "(verbatim into an empty store, "
+                             "superset-safe merge into a live one)")
+    pr.add_argument("--store", required=True)
+    pr.add_argument("--from", dest="generation", default=None,
+                    metavar="GEN",
+                    help="generation directory (default: the latest "
+                         "under <store>/backups)")
+    pr.add_argument("--out", default=None, metavar="DIR",
+                    help="generations root searched when --from is "
+                         "omitted")
+    pr.add_argument("--force", action="store_true",
+                    help="restore the intact files of a generation "
+                         "that fails catalog verification")
+
+    pf = sub.add_parser("fsck",
+                        help="deep read-only integrity walk; exit 0 "
+                             "clean / 1 damaged / 2 unreadable")
+    pf.add_argument("--store", required=True)
+    pf.add_argument("--adopt", action="store_true",
+                    help="index orphan segments into the manifest "
+                         "(the only write fsck can do)")
+    pf.add_argument("--stamp", action="store_true",
+                    help="record the verdict to <store>/fsck.json for "
+                         "report --follow")
+    pf.add_argument("--no-backups", action="store_true",
+                    help="skip the backup-generation census")
+
     args = ap.parse_args(argv)
     trace_out = getattr(args, "trace_out", None)
     if trace_out:
         from tenzing_tpu import obs
 
         obs.configure(enabled=True)
+    if args.cmd in ("backup", "restore", "fsck"):
+        from tenzing_tpu.serve import dr
+
+        log = lambda m: sys.stderr.write(m + "\n")  # noqa: E731
+        try:
+            if args.cmd == "backup":
+                _emit(dr.backup_store(args.store, out_dir=args.out,
+                                      note=args.note, log=log))
+                return 0
+            if args.cmd == "restore":
+                gen = args.generation or dr.latest_generation(
+                    args.out or dr.backups_root(args.store))
+                if gen is None:
+                    raise dr.DrError(
+                        f"no backup generations found for {args.store}")
+                _emit(dr.restore_store(args.store, gen,
+                                       force=args.force, log=log))
+                return 0
+            doc = dr.fsck_store(args.store, adopt=args.adopt,
+                                stamp=args.stamp,
+                                check_backups=not args.no_backups,
+                                log=log)
+            _emit(doc)
+            return doc["rc"]
+        except dr.DrError as e:
+            sys.stderr.write(f"serve {args.cmd}: {e}\n")
+            return 2
     if args.cmd == "compact":
         from tenzing_tpu.serve.segments import Compactor
 

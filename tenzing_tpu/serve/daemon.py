@@ -86,20 +86,33 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from tenzing_tpu.fault.backoff import BackoffPolicy, retry_call
-from tenzing_tpu.fault.checkpoint import atomic_write_json, read_checked_json
+from tenzing_tpu.fault.checkpoint import (
+    FENCE_ENV,
+    atomic_write_json,
+    read_checked_json,
+)
 from tenzing_tpu.fault.errors import (
     DeterministicScheduleError,
     DeviceLostError,
     FaultClass,
+    FencedWriteError,
     MeasurementTimeout,
+    StoreReadonlyError,
     TransientError,
     classify_error,
+    is_transient_io,
+    is_unwritable_io,
 )
 from tenzing_tpu.obs import context as obs_context
 from tenzing_tpu.obs.metrics import MetricsSnapshotWriter, get_metrics
 from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.serve.lease import LeaseFile
-from tenzing_tpu.serve.store import WorkQueue
+from tenzing_tpu.serve.store import (
+    WorkQueue,
+    mark_store_unwritable,
+    probe_store_writable,
+    store_readonly,
+)
 from tenzing_tpu.utils.atomic import atomic_dump_json
 
 STATUS_VERSION = 1
@@ -254,17 +267,30 @@ def _exec_item_main(item_path: str, out_path: str,
     success; 3 on failure — the parent reads the report and re-raises the
     class, so the daemon's retry/poison policy never depends on parsing
     stderr."""
+    # the report write retries transients in-process (same shared-backoff
+    # rule as store and checkpoint writes): this is the child's ONLY way
+    # to tell the parent what happened, a fresh child replays the same
+    # injected-fault schedule, and "exited with no error report" is
+    # classified deterministic — a drained item would poison on a
+    # bounded write burst after the work already succeeded
+    def report(doc: Dict[str, Any]) -> None:
+        retry_call(
+            lambda: atomic_dump_json(out_path, doc, prefix=".verdict."),
+            policy=BackoffPolicy(retries=4, base_secs=0.05, factor=2.0,
+                                 max_secs=0.5),
+            retry_on=is_transient_io, where="serve.drain.report")
+
     try:
         payload = read_checked_json(item_path)
         verdict = exec_item(payload, item_path, overrides)
     except BaseException as e:
-        atomic_dump_json(out_path, {
+        report({
             "error": str(e)[:2000],
             "error_class": classify_error(e),
             "error_type": type(e).__name__,
-        }, prefix=".verdict.")
+        })
         return 3
-    atomic_dump_json(out_path, verdict, prefix=".verdict.")
+    report(verdict)
     return 0
 
 
@@ -326,7 +352,8 @@ class DrainDaemon:
         self.counters: Dict[str, int] = {
             k: 0 for k in ("claimed", "completed", "retried", "poisoned",
                            "reclaimed", "released", "failed_transient",
-                           "failed_deterministic", "lease_lost", "signals")}
+                           "failed_deterministic", "lease_lost", "fenced",
+                           "store_unwritable", "signals")}
         self.history: List[Dict[str, Any]] = []
         self.device_lost = False
         self.started_at = time.time()
@@ -404,6 +431,9 @@ class DrainDaemon:
             "item": item,
             "queue_depth": self._depth,
             "counters": dict(self.counters),
+            # the read-only degradation latch (serve/store.py): non-None
+            # while claims are paused because store writes cannot land
+            "store_readonly": store_readonly(self.opts.store_path),
             # bounded per-item drain economics, mined by the report CLI
             "history": self.history[-20:],
         }
@@ -456,10 +486,18 @@ class DrainDaemon:
             "error_class": error_class,
             "message": str(exc)[:500],
         })
-        atomic_dump_json(self.queue.fail_path_for(exact), {
-            "version": FAIL_VERSION, "exact": exact, "det_count": det,
-            "attempts": attempts[-FAIL_ATTEMPT_CAP:],
-        }, prefix=".fail.")
+        try:
+            atomic_dump_json(self.queue.fail_path_for(exact), {
+                "version": FAIL_VERSION, "exact": exact, "det_count": det,
+                "attempts": attempts[-FAIL_ATTEMPT_CAP:],
+            }, prefix=".fail.")
+        except OSError as e:
+            # a full/hostile filesystem must not turn a failure *record*
+            # into a daemon crash — the item stays queued either way; the
+            # only cost is poison progress not advancing this visit
+            if is_unwritable_io(e):
+                mark_store_unwritable(self.opts.store_path, e)
+            self._log(f"failure sidecar write failed for {exact[:12]} ({e})")
         return det
 
     def _poison(self, item_path: str, payload: Dict[str, Any],
@@ -500,7 +538,29 @@ class DrainDaemon:
         cannot be killed — the resilient layer's per-measurement
         watchdog, ``measure_timeout`` on the request, is the only hang
         bound here).  The production path is the subprocess runner."""
-        return exec_item(payload, item_path, self.opts.overrides)
+        fence = self._fence_token()
+        prev = os.environ.get(FENCE_ENV)
+        if fence is not None:
+            os.environ[FENCE_ENV] = fence
+        try:
+            return exec_item(payload, item_path, self.opts.overrides)
+        finally:
+            if fence is not None:
+                if prev is None:
+                    os.environ.pop(FENCE_ENV, None)
+                else:
+                    os.environ[FENCE_ENV] = prev
+
+    def _fence_token(self) -> Optional[str]:
+        """``<lease-path>:<epoch>`` for the current claim, or None when
+        the claim stands unfenced (registry write failed — serve/lease.py
+        degrades to nonce checks).  Exported to the drain runner so the
+        checkpoint journal refuses a zombie's late appends
+        (fault/checkpoint.py ``FENCE_ENV``)."""
+        lf = self._lease
+        if lf is None or lf.epoch is None:
+            return None
+        return f"{lf.path}:{lf.epoch}"
 
     def _run_subprocess(self, item_path: str, payload: Dict[str, Any],
                         timeout: Optional[float]) -> Dict[str, Any]:
@@ -531,6 +591,12 @@ class DrainDaemon:
             obs_context.from_json(payload.get("trace")))
         if self.opts.trace_out:
             env[TRACE_CHILD_ENV] = "1"
+        fence = self._fence_token()
+        if fence is not None:
+            # the child's checkpoint journal checks our lease epoch on
+            # every append: if a rival fences us mid-drain, the zombie
+            # child's late writes die there instead of landing stale
+            env[FENCE_ENV] = fence
         with open(log_path, "ab") as log_f:
             proc = subprocess.Popen(cmd, stdout=log_f, stderr=log_f,
                                     env=env)
@@ -672,6 +738,12 @@ class DrainDaemon:
                 policy=BackoffPolicy(retries=self.opts.retries,
                                      base_secs=self.opts.backoff_base_secs),
                 where="daemon.drain", on_retry=on_retry)
+            # the epoch fence: if a rival reclaimed us during a stall
+            # (coarse/skewed mtimes can make our lease look expired while
+            # our own clock says it is fresh), the registry holds a newer
+            # epoch and this raises — the stale merge never starts
+            if self._lease is not None and self._lease.path == lease:
+                self._lease.check_fence()
             merged = self._merge(item_path, payload, verdict)
             # the merge has landed (flushed under the store flock):
             # ONLY NOW may item + sidecar + lease disappear — a crash
@@ -681,6 +753,10 @@ class DrainDaemon:
                     os.unlink(p)
                 except OSError:
                     pass
+            if self._lease is not None and self._lease.path == lease:
+                # effects landed: retire the fencing epochs so the
+                # registry stays bounded (serve/lease.py EPOCH_KEEP)
+                self._lease.purge_epochs()
             self.counters["completed"] += 1
             get_metrics().counter("daemon.completed").inc()
             self._log(f"completed {exact[:12]} ({merged} record(s) merged, "
@@ -699,8 +775,31 @@ class DrainDaemon:
             self.counters["lease_lost"] += 1
             self._log(f"lease for {exact[:12]} reclaimed by a rival — "
                       "abandoning (no merge)")
+        except FencedWriteError as e:
+            # a rival holds a newer epoch: we are the zombie the fence
+            # exists for.  Abandon without merging, without a failure
+            # record (the item is in better hands, never evidence
+            # against the request), and without releasing a lease that
+            # is no longer ours
+            outcome = "fenced"
+            self.counters["fenced"] += 1
+            get_metrics().counter("daemon.fenced").inc()
+            self._log(f"fenced on {exact[:12]}: {e} — abandoning (no merge)")
         except BaseException as e:
             err = e
+            if isinstance(e, StoreReadonlyError) or is_unwritable_io(e):
+                # the store cannot take the merge (ENOSPC/EROFS/quota):
+                # latch read-only and leave the item queued — NOT a
+                # failure of the request, so no fail sidecar, no poison
+                # progress; the run loop pauses claims until a probe
+                # write succeeds
+                outcome = "store_unwritable"
+                self.counters["store_unwritable"] += 1
+                mark_store_unwritable(self.opts.store_path, e)
+                get_metrics().counter("daemon.store_unwritable").inc()
+                self._log(f"store unwritable on {exact[:12]} ({e}) — "
+                          "pausing claims until writable")
+                return outcome
             if not os.path.exists(item_path):
                 # a rival completed + deleted the item between our queue
                 # scan and this drain (the lease was already gone, so the
@@ -738,8 +837,12 @@ class DrainDaemon:
         finally:
             hb_stop.set()
             hb.join(timeout=5.0)
-            if outcome != "lease_lost":
+            if outcome not in ("lease_lost", "fenced"):
+                # fenced = a rival holds a newer claim under our old
+                # name: what's on disk is theirs, not ours to delete
                 self._release(lease)
+            else:
+                self._lease = None
             after = self._journal_lines(ckpt)
             self.history.append({
                 "exact": exact,
@@ -828,6 +931,24 @@ class DrainDaemon:
         self._write_status("idle")
         try:
             while not self._stop.is_set():
+                if store_readonly(self.opts.store_path) is not None:
+                    # degraded read-only: merges cannot land, so claiming
+                    # would only churn leases and burn drain work.  Pause
+                    # (visible in the status doc) and probe each poll —
+                    # the latch clears itself the moment a write lands.
+                    if not probe_store_writable(self.opts.store_path):
+                        self._observe_queue()
+                        self._write_status("paused")
+                        self._stop.wait(self.opts.poll_secs)
+                        if self.opts.once:
+                            break
+                        continue
+                    self._log("store writable again — resuming claims")
+                    # rewrite the status doc NOW: the paused doc (with
+                    # its latch block) is what keeps store_unwritable
+                    # firing, and an idle daemon may not write another
+                    # status until it exits
+                    self._write_status("idle")
                 items = self._observe_queue()
                 processed = progressed = 0
                 for path, payload in items:

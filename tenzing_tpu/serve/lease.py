@@ -1,5 +1,5 @@
 """THE lease-file protocol: claim-by-hardlink, mtime-heartbeat,
-reclaim-by-rename, grab-inspect-release.
+reclaim-by-rename, grab-inspect-release — plus epoch fencing tokens.
 
 One mutual-exclusion discipline for every long-running exclusive job in
 the serving tree — extracted from serve/daemon.py (where it was born, PR
@@ -30,6 +30,36 @@ compaction lease.  The invariants, each carried over verbatim:
   grab window the re-link loses and the rival's own heartbeat detects
   the loss (nonce mismatch) and aborts — the designed recovery, never a
   silent double-run.
+
+**Epoch fencing** (the hostile-filesystem hardening): mtime-TTL reclaim
+trusts two things a shared NFS-like mount does not guarantee — the
+observed mtime (1s-granularity coarsening / client-clock skew can age a
+live rival's heartbeat into "expired") and the freshness of the nonce
+re-read (an attribute-cached read can serve the *previous* lease payload
+— our own — and tell a reclaimed zombie it still owns).  Both lies let
+two holders drain one item: the documented double-run hole.  The fence
+closes it at the *write* side:
+
+* Every successful claim carries a monotonically-increasing **epoch**,
+  allocated from and recorded into a registry directory next to the
+  lease (``<path>.epochs/c-<N>``, created ``O_EXCL`` — the atomic
+  winner-takes-all step again, directory entries rather than file
+  content precisely so a stale *content* read cannot lie about them).
+  The payload's ``epoch`` field and :attr:`ClaimInfo.epoch` report it.
+* Before any effect lands — the daemon's store merge, a checkpoint
+  journal append (fault/checkpoint.py) — the holder calls
+  :meth:`LeaseFile.check_fence` / :func:`check_epoch`: if the registry
+  shows an epoch newer than ours, a rival has claimed since; the write
+  raises :class:`~tenzing_tpu.fault.errors.FencedWriteError` instead of
+  landing stale.  A *vanished* registry with our marker gone means the
+  rival already completed and cleaned up — equally fenced.
+
+Expiry clocks and nonce re-reads go through the utils/atomic.py I/O seam
+(``io_getmtime`` / ``read_json``) so fault/fsinject.py can inject
+exactly the mtime coarsening/skew and stale reads the fence exists to
+survive; the registry operations deliberately do not — O_EXCL create and
+listdir are the layer chaos must not be able to lie to
+(tests/test_lease_fencing.py drills both halves).
 """
 
 from __future__ import annotations
@@ -42,16 +72,64 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
+from tenzing_tpu.fault.errors import FencedWriteError
+from tenzing_tpu.utils.atomic import io_getmtime, read_json
+
+# the fencing registry rides next to the lease file; entries are
+# c-<epoch> markers, one per *successful* claim, newest few kept
+EPOCH_DIR_SUFFIX = ".epochs"
+EPOCH_KEEP = 8
+
+
+def epoch_registry_of(path: str) -> str:
+    """The fencing registry directory of lease ``path``."""
+    return path + EPOCH_DIR_SUFFIX
+
+
+def issued_epoch(path: str) -> int:
+    """The highest epoch any successful claim of ``path`` has recorded
+    (0 when none / the registry is absent)."""
+    best = 0
+    try:
+        names = os.listdir(epoch_registry_of(path))
+    except OSError:
+        return 0
+    for n in names:
+        if n.startswith("c-"):
+            try:
+                best = max(best, int(n[2:]))
+            except ValueError:
+                pass
+    return best
+
+
+def check_epoch(path: str, epoch: int) -> None:
+    """Raise :class:`FencedWriteError` unless ``epoch`` is exactly the
+    newest successful claim of lease ``path`` (see module docstring —
+    newer means a rival reclaimed us; older/absent means the rival
+    already completed and purged the registry).  THE one fence check,
+    shared by the holder object, the daemon's merge gate, and the
+    checkpoint journal's env-wired hook (fault/checkpoint.py)."""
+    newest = issued_epoch(path)
+    if newest != epoch:
+        raise FencedWriteError(
+            f"lease {os.path.basename(path)} epoch {epoch} fenced "
+            f"(registry newest: {newest}) — a rival claim supersedes "
+            "this holder; abandoning the write")
+
 
 @dataclass
 class ClaimInfo:
     """What :meth:`LeaseFile.claim` reports on success: whether the claim
     reclaimed an expired rival first (the caller's counter/telemetry
-    decision, not the protocol's), and whose."""
+    decision, not the protocol's), whose, and the claim's fencing epoch
+    (None when the registry could not record it — fencing degrades to
+    the nonce checks, never blocks the claim)."""
 
     reclaimed: bool = False
     prev_owner: Optional[str] = None
     age_s: Optional[float] = None
+    epoch: Optional[int] = None
 
 
 class LeaseFile:
@@ -67,11 +145,66 @@ class LeaseFile:
         self.owner = owner
         self.ttl_secs = float(ttl_secs)
         self.nonce: Optional[str] = None
+        self.epoch: Optional[int] = None
         self._log = log
 
     def _note(self, msg: str) -> None:
         if self._log is not None:
             self._log(msg)
+
+    # -- fencing -------------------------------------------------------------
+    def _record_epoch(self, epoch: int) -> bool:
+        """Record a successful claim's epoch marker (O_EXCL — atomic) and
+        trim the registry tail.  False when the marker could not land:
+        the claim stands, fencing degrades to the nonce checks."""
+        d = epoch_registry_of(self.path)
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd = os.open(os.path.join(d, f"c-{epoch}"),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+        except OSError:
+            return False
+        try:
+            for n in os.listdir(d):
+                if not n.startswith("c-"):
+                    continue
+                try:
+                    k = int(n[2:])
+                except ValueError:
+                    continue
+                if k <= epoch - EPOCH_KEEP:
+                    try:
+                        os.unlink(os.path.join(d, n))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return True
+
+    def check_fence(self) -> None:
+        """Raise :class:`FencedWriteError` iff a rival claim supersedes
+        this holder's epoch (module docstring).  A no-op for unfenced
+        claims (epoch marker never landed): those fall back to the
+        nonce-re-read protection alone."""
+        if self.epoch is not None:
+            check_epoch(self.path, self.epoch)
+
+    def purge_epochs(self) -> None:
+        """Drop the fencing registry — called by the *completing* holder
+        after the guarded effect landed and the work item is gone (a
+        later zombie is fenced by the registry's absence, and a fresh
+        item at the same path restarts epochs from 1)."""
+        d = epoch_registry_of(self.path)
+        try:
+            for n in os.listdir(d):
+                try:
+                    os.unlink(os.path.join(d, n))
+                except OSError:
+                    pass
+            os.rmdir(d)
+        except OSError:
+            pass
 
     # -- claim ---------------------------------------------------------------
     def claim(self, extra: Optional[Dict[str, Any]] = None
@@ -81,8 +214,12 @@ class LeaseFile:
         stamps the claimed item's exact digest)."""
         now = time.time()
         info = ClaimInfo()
+        self.epoch = None
         try:
-            age = now - os.path.getmtime(self.path)
+            # the expiry clock reads through the I/O seam: coarse or
+            # skewed observed mtimes are exactly the chaos the fence
+            # (below) exists to survive
+            age = now - io_getmtime(self.path)
         except OSError:
             age = None  # no lease: go straight to the fresh claim
         if age is not None:
@@ -112,12 +249,13 @@ class LeaseFile:
         # written and fsynced in a private temp file before the link, so
         # a rival never reads a torn lease, and the link itself is the
         # atomic winner-takes-all step
+        epoch = issued_epoch(self.path) + 1
         nonce = (f"{self.owner}-{os.getpid()}-{threading.get_ident()}-"
                  f"{int(now * 1e6)}")
         payload = {"owner": self.owner, "pid": os.getpid(),
                    "host": socket.gethostname(),
                    "claimed_at": now, "ttl_s": self.ttl_secs,
-                   "nonce": nonce, **(extra or {})}
+                   "nonce": nonce, "epoch": epoch, **(extra or {})}
         os.makedirs(os.path.dirname(os.path.abspath(self.path)),
                     exist_ok=True)
         # thread id in the temp name: two same-owner holders embedded in
@@ -134,6 +272,11 @@ class LeaseFile:
             except OSError:
                 return None  # a rival landed first
             self.nonce = nonce
+            # record the fence marker ONLY as the winner — losers must
+            # never advance the registry past the live holder's epoch
+            if self._record_epoch(epoch):
+                self.epoch = epoch
+                info.epoch = epoch
         finally:
             try:
                 os.unlink(tmp)
@@ -146,8 +289,10 @@ class LeaseFile:
         if self.nonce is None:
             return False  # nothing claimed; never matches a nonce-less file
         try:
-            with open(self.path) as f:
-                return json.load(f).get("nonce") == self.nonce
+            # through the seam: an NFS-style stale read can serve OUR OWN
+            # superseded payload here and lie to a reclaimed zombie —
+            # which is why effects must also pass check_fence()
+            return read_json(self.path).get("nonce") == self.nonce
         except (OSError, ValueError):
             return False
 
@@ -168,6 +313,7 @@ class LeaseFile:
         """Grab-inspect-release (module docstring); returns True iff the
         lease was ours and is now deleted.  Always clears the nonce —
         after a release attempt this object holds nothing."""
+        self.epoch = None
         if self.nonce is None:
             return False
         grab = (f"{self.path}.release.{self.owner}.{os.getpid()}."

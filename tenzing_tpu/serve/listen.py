@@ -96,9 +96,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from tenzing_tpu.fault.errors import classify_error
+from tenzing_tpu.fault.errors import StoreReadonlyError, classify_error
 from tenzing_tpu.obs import context as obs_context
 from tenzing_tpu.serve.resolver import fp_cache_key
+from tenzing_tpu.serve.store import probe_store_writable, store_readonly
 from tenzing_tpu.obs.metrics import (
     MetricsSnapshotWriter,
     SloConfig,
@@ -246,6 +247,9 @@ class ServeLoop:
         self._prev_handlers: Dict[int, Any] = {}
         self.last_request_at = time.time()
         store_path = getattr(self.service.store, "path", None)
+        # the readonly-probe target (and the status doc's "store" key)
+        self._store_path = store_path if isinstance(store_path, str) \
+            else None
         base = (os.path.dirname(os.path.abspath(store_path))
                 if isinstance(store_path, str) and store_path.endswith(
                     ".json")
@@ -315,6 +319,7 @@ class ServeLoop:
             "in_flight": len(self._live),
             "counters": dict(self.counters),
             "store": getattr(self.service.store, "path", None),
+            "store_readonly": store_readonly(self._store_path),
             "socket": self.opts.socket_path,
         }
         try:
@@ -584,13 +589,34 @@ class ServeLoop:
                 t = r.get("tenant", tenant) if isinstance(r, dict) else tenant
                 try:
                     results.append(self._resolve_one(req, tenant=t))
+                except StoreReadonlyError as e:
+                    # degraded read-only: this member needed a store
+                    # write (near/cold) — shed it explicitly; exact
+                    # members of the same batch still answer above
+                    self._bump("shed")
+                    results.append(self._readonly_shed_doc(e))
                 except Exception as e:
                     results.append({"error": str(e)[:500],
                                     "error_class": classify_error(e)})
             return {"ok": True, "results": results}
-        return {"ok": True,
-                "result": self._resolve_one(payload.get("request") or {},
-                                            tenant=tenant)}
+        try:
+            return {"ok": True,
+                    "result": self._resolve_one(payload.get("request") or {},
+                                                tenant=tenant)}
+        except StoreReadonlyError as e:
+            self._bump("shed")
+            return self._readonly_shed_doc(e)
+
+    def _readonly_shed_doc(self, exc: BaseException) -> Dict[str, Any]:
+        """The store-readonly shed response (docs/robustness.md
+        "Degraded read-only mode"): transient by classification — the
+        latch clears when a probe write lands, so retry-later is the
+        honest hint.  Exact-tier traffic never sees this: the sealed
+        cache keeps answering throughout the outage."""
+        get_metrics().counter("serve.shed").inc()
+        return {"ok": False, "shed": True, "reason": "store_readonly",
+                "retry_after": self.opts.shed_retry_after_secs,
+                "error": str(exc)[:300], "error_class": "transient"}
 
     def _next_pending(self):
         """One queue fetch: a bounded ``get_nowait()`` spin first
@@ -790,6 +816,12 @@ class ServeLoop:
             "queue_depth": self._queue.qsize(),
             "in_flight": len(self._live),
             "uptime_s": round(time.time() - self.started_at, 1)}
+        ro = store_readonly(self._store_path)
+        if ro is not None:
+            # the store_unwritable alert rule keys on this block
+            # (obs/alerts.py): present while degraded, absent once the
+            # heartbeat's probe write lands — fire-then-resolve
+            out["store_readonly"] = ro
         if self._reqlog is not None:
             out["reqlog"] = self._reqlog.position()
         return out
@@ -814,6 +846,15 @@ class ServeLoop:
 
     def _heartbeat(self) -> None:
         while not self._stop.wait(self.opts.heartbeat_secs):
+            if self._store_path is not None and \
+                    store_readonly(self._store_path) is not None:
+                # degraded read-only: one tiny probe write per heartbeat
+                # (through the same atomic seam real writes use) clears
+                # the latch the moment the filesystem recovers — near/
+                # cold resolution resumes without operator action
+                if probe_store_writable(self._store_path):
+                    self._log("store writable again — resuming "
+                              "near/cold tiers")
             self._write_status("serving")
             self._observe_gauges()
             try:

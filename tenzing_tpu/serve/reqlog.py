@@ -57,12 +57,19 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tenzing_tpu.fault.backoff import BackoffPolicy, retry_call
+from tenzing_tpu.fault.errors import is_transient_io
 from tenzing_tpu.obs.metrics import get_metrics
 # THE per-line checksum, owner-token and sealed-publish helpers —
 # shared with the segmented store so neither the checksum format nor
 # the publish discipline can silently diverge between the two
 from tenzing_tpu.serve.segments import _owner_token, record_digest
 from tenzing_tpu.utils.atomic import publish_sealed
+
+# transient-EIO retries for a segment publish — short and bounded: the
+# recorder rides the heartbeat thread, not the request path
+_PUBLISH_RETRY = BackoffPolicy(retries=2, base_secs=0.05, factor=4.0,
+                               max_secs=0.5, jitter=0.25)
 
 REQLOG_VERSION = 1
 EXEMPLAR_VERSION = 1
@@ -121,6 +128,11 @@ class RequestLog:
         self.dropped_sampling = 0
         self.segments_published = 0
         self.segments_reclaimed = 0
+        # count-and-drop bookkeeping (docs/robustness.md "Degraded
+        # read-only mode"): a full/hostile filesystem costs records,
+        # visibly, never the serving path — both surface in position()
+        self.dropped_write = 0
+        self.write_errors = 0
         self.last_segment: Optional[str] = None
 
     def _note(self, msg: str) -> None:
@@ -177,10 +189,14 @@ class RequestLog:
             return self.last_segment if n else None
         return self._publish(recs)
 
-    def _publish(self, recs: List[Dict[str, Any]]) -> str:
+    def _publish(self, recs: List[Dict[str, Any]]) -> Optional[str]:
         """Seal + atomically publish one segment, then apply retention
         (utils/atomic.py ``publish_sealed`` — the same discipline as
-        the segmented store's segments)."""
+        the segmented store's segments).  Transient EIO retries through
+        THE shared backoff; a publish that still fails (ENOSPC, dead
+        disk) **counts and drops** the batch — recording must degrade,
+        never wedge the loop or grow memory without bound.  Returns the
+        published name, or None when the batch was dropped."""
         with self._lock:
             dropped = self.dropped_sampling
         header = {"kind": "reqlog_segment", "version": REQLOG_VERSION,
@@ -201,7 +217,20 @@ class RequestLog:
             return (f"req-{int(time.time() * 1e6)}-"
                     f"{self.owner}-{n}.jsonl")
 
-        name = publish_sealed(self.dir, make_name, text)
+        try:
+            name = retry_call(
+                lambda: publish_sealed(self.dir, make_name, text),
+                policy=_PUBLISH_RETRY, retry_on=is_transient_io,
+                where="serve.reqlog.publish")
+        except OSError as e:
+            with self._lock:
+                self.dropped_write += len(recs)
+                self.write_errors += 1
+            get_metrics().counter(
+                "serve.reqlog.dropped_write").inc(len(recs))
+            self._note(f"reqlog: dropped {len(recs)} record(s), publish "
+                       f"failed ({e})")
+            return None
         with self._lock:
             self.records_written += len(recs)
             self.bytes_written += len(text)
@@ -250,6 +279,8 @@ class RequestLog:
                 "buffered": (len(self._buffer)
                              + sum(len(b) for b in self._pending)),
                 "dropped_sampling": self.dropped_sampling,
+                "dropped_write": self.dropped_write,
+                "write_errors": self.write_errors,
             }
 
 

@@ -79,11 +79,18 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from tenzing_tpu.fault.errors import StoreReadonlyError, is_unwritable_io
 from tenzing_tpu.obs import context as obs_context
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.obs.tracer import get_tracer
 from tenzing_tpu.serve.fingerprint import WorkloadFingerprint, fingerprint_of
-from tenzing_tpu.serve.store import Record, ScheduleStore, WorkQueue
+from tenzing_tpu.serve.store import (
+    Record,
+    ScheduleStore,
+    WorkQueue,
+    mark_store_unwritable,
+    store_readonly,
+)
 
 # sealed-response slot sentinels: a memoized response carries these at
 # the per-request fields' natural positions, so patching them in place
@@ -664,6 +671,29 @@ class Resolver:
             provenance={"was_predicted": False, "compiles": 0,
                         "measurements": 0})
 
+    def _near_or_cold(self, req, fp: WorkloadFingerprint) -> Resolution:
+        """The write-needing tiers, gated on the read-only latch
+        (serve/store.py): near flags + enqueues, cold enqueues — none of
+        that can land while the store is degraded, so both shed with
+        :class:`StoreReadonlyError` (the listen loop converts it to a
+        ``{"shed": true, "reason": "store_readonly"}`` response; exact
+        hits above keep answering from the sealed cache throughout).  An
+        ENOSPC-family OSError escaping a tier write trips the latch
+        here, so the *next* request sheds before touching the disk."""
+        ro = store_readonly(self.store.path)
+        if ro is not None:
+            get_metrics().counter("serve.shed.store_readonly").inc()
+            raise StoreReadonlyError(
+                f"store degraded read-only ({ro.get('error')})")
+        try:
+            return self._try_near(req, fp) or self._cold(req, fp)
+        except OSError as e:
+            if is_unwritable_io(e):
+                mark_store_unwritable(self.store.path, e)
+                get_metrics().counter("serve.shed.store_readonly").inc()
+                raise StoreReadonlyError(str(e)) from e
+            raise
+
     @staticmethod
     def _request_payload(req) -> Dict[str, Any]:
         fn = getattr(req, "to_json", None)
@@ -710,9 +740,9 @@ class Resolver:
             sp.set("workload", fp.workload)
             sp.set("exact", fp.exact_digest)
             sp.set("bucket", fp.bucket_digest)
-            res = (self._try_exact(req, fp, phases)
-                   or self._try_near(req, fp)
-                   or self._cold(req, fp))
+            res = self._try_exact(req, fp, phases)
+            if res is None:
+                res = self._near_or_cold(req, fp)
             sp.set("tier", res.tier)
         res.phase_us = phases
         res.trace_id = ctx.trace_id

@@ -89,6 +89,7 @@ from tenzing_tpu.serve.store import (
     RECORD_SCHEMA,
     Record,
     ScheduleStore,
+    guarded_store_write,
     migrate_record,
 )
 from tenzing_tpu.utils.atomic import atomic_dump_json, publish_sealed
@@ -287,7 +288,13 @@ class SegmentedStore(ScheduleStore):
                 "version": MANIFEST_VERSION, "segments": {},
                 "compactions": []}
             doc = fn(doc)
-            atomic_dump_json(self.manifest_path, doc, prefix=".manifest.")
+            # hardened: transient EIO retries through the shared backoff;
+            # ENOSPC/EROFS latches the store read-only (serve/store.py)
+            guarded_store_write(
+                self.dir,
+                lambda: atomic_dump_json(self.manifest_path, doc,
+                                         prefix=".manifest."),
+                where="serve.store.manifest")
         self.manifest_doc = doc
 
     # -- loading -------------------------------------------------------------
@@ -335,8 +342,12 @@ class SegmentedStore(ScheduleStore):
     def _load_one_segment(self, name: str, listed: bool) -> int:
         path = os.path.join(self.segments_path, name)
         try:
-            with open(path) as f:
-                lines = f.read().splitlines()
+            # bytes first: a bit flip can make the file invalid UTF-8,
+            # and that must damage ONE line's checksum, not crash the
+            # whole load
+            with open(path, "rb") as f:
+                lines = f.read().decode(
+                    "utf-8", errors="replace").splitlines()
         except OSError:
             # unlinked between listdir and open: a compactor reclaimed
             # it — its records live in the published compact segment
@@ -458,7 +469,10 @@ class SegmentedStore(ScheduleStore):
             return (f"seg-{bucket}-{int(time.time() * 1e6)}-"
                     f"{self.owner}-{self._seg_counter}.jsonl")
 
-        name = publish_sealed(self.segments_path, make_name, text)
+        name = guarded_store_write(
+            self.dir,
+            lambda: publish_sealed(self.segments_path, make_name, text),
+            where="serve.store.publish_segment")
         meta = {"bucket": bucket, "records": len(recs),
                 "bytes": len(text), "created_at": header["created_at"],
                 "source": source, "sealed": True}

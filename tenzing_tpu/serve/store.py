@@ -45,10 +45,13 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tenzing_tpu.fault.backoff import BackoffPolicy, retry_call
 from tenzing_tpu.fault.checkpoint import atomic_write_json, read_checked_json
+from tenzing_tpu.fault.errors import is_transient_io, is_unwritable_io
 from tenzing_tpu.obs.metrics import get_metrics
 from tenzing_tpu.obs.tracer import get_tracer, short_digest
 from tenzing_tpu.utils.atomic import atomic_dump_json
@@ -57,6 +60,116 @@ STORE_VERSION = 1
 RECORD_SCHEMA = 2
 
 Record = Dict[str, Any]
+
+# -- read-only degradation latch --------------------------------------------
+# One process-wide latch per store path (abspath-keyed): when a durable
+# store write dies on the unwritable errno family (ENOSPC/EDQUOT/EROFS —
+# fault/errors.py), the serve plane degrades instead of thrashing: the
+# listen loop keeps answering exact from the sealed cache and sheds
+# cold/near with reason "store_readonly", the drain daemon pauses claims
+# instead of accumulating bogus poison verdicts, and reqlog counts-and-
+# drops.  The latch clears on any successful write — a real flush or an
+# explicit probe (docs/robustness.md "Disaster recovery").
+_READONLY: Dict[str, Dict[str, Any]] = {}
+_READONLY_LOCK = threading.Lock()
+
+# transient-EIO policy for durable store writes: a flaky-disk write
+# retries through THE shared backoff (fault/backoff.py) on a millisecond
+# timescale; the unwritable family never retries (space does not come
+# back between attempts), it latches
+_IO_RETRY = BackoffPolicy(retries=2, base_secs=0.05, factor=4.0,
+                          max_secs=0.5, jitter=0.25)
+
+
+def _store_key(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def mark_store_unwritable(path: str, exc: BaseException) -> Dict[str, Any]:
+    """Latch ``path``'s store read-only (idempotent; first trip counts
+    ``serve.store.readonly_trips`` and stamps the latch doc)."""
+    key = _store_key(path)
+    with _READONLY_LOCK:
+        doc = _READONLY.get(key)
+        if doc is None:
+            doc = {
+                "reason": "store_readonly",
+                "errno": getattr(exc, "errno", None),
+                "error": f"{type(exc).__name__}: {str(exc)[:200]}",
+                "since": time.time(),
+            }
+            _READONLY[key] = doc
+            get_metrics().counter("serve.store.readonly_trips").inc()
+            get_metrics().gauge("serve.store.readonly").set(1.0)
+            tr = get_tracer()
+            if tr.enabled:
+                tr.event("serve.store.readonly", store=key,
+                         error=doc["error"])
+    return doc
+
+
+def store_readonly(path: Optional[str]) -> Optional[Dict[str, Any]]:
+    """The latch doc when ``path``'s store is degraded read-only, else
+    None.  Read by the resolver's cold/near gates, the daemon's pause
+    loop, and every status/report surface."""
+    if path is None:
+        return None
+    with _READONLY_LOCK:
+        return _READONLY.get(_store_key(path))
+
+
+def clear_store_unwritable(path: str) -> bool:
+    """Drop the latch (a write landed / a probe succeeded); True iff it
+    was set."""
+    with _READONLY_LOCK:
+        doc = _READONLY.pop(_store_key(path), None)
+    if doc is not None:
+        get_metrics().gauge("serve.store.readonly").set(0.0)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.event("serve.store.writable", store=_store_key(path))
+    return doc is not None
+
+
+def probe_store_writable(path: str) -> bool:
+    """Attempt one tiny durable write next to the store (through the
+    same atomic seam real writes use, so chaos governs it too); clears
+    the latch and returns True on success.  The listen heartbeat and the
+    paused daemon poll this — the ``store_unwritable`` alert resolves
+    when it starts succeeding."""
+    if path.endswith(".json") and not os.path.isdir(path):
+        probe = path + ".probe"
+    else:
+        probe = os.path.join(path, ".probe.json")
+    try:
+        atomic_dump_json(probe, {"probe_at": time.time()}, prefix=".probe.")
+    except OSError:
+        return False
+    try:
+        os.unlink(probe)
+    except OSError:
+        pass
+    clear_store_unwritable(path)
+    return True
+
+
+def guarded_store_write(store_path: Optional[str], fn,
+                        where: str = "serve.store.write"):
+    """Run one durable store write: transient I/O errors (EIO family)
+    retry through THE shared fault/backoff.py; the unwritable family
+    latches the store read-only and re-raises; success clears any
+    latch.  Every segment/manifest/monolithic flush funnels through
+    here (serve/segments.py too)."""
+    try:
+        out = retry_call(fn, policy=_IO_RETRY, retry_on=is_transient_io,
+                         where=where)
+    except OSError as e:
+        if store_path is not None and is_unwritable_io(e):
+            mark_store_unwritable(store_path, e)
+        raise
+    if store_path is not None and store_readonly(store_path) is not None:
+        clear_store_unwritable(store_path)
+    return out
 
 
 def file_digest(path: str) -> str:
@@ -388,8 +501,13 @@ class ScheduleStore:
                                          _count_metrics=False)
                     for rec in disk.records():
                         self._put(dict(rec))
-                atomic_dump_json(self.path, self.to_json(),
-                                 prefix=".store.")
+                # transient-EIO retries + the read-only latch on the
+                # unwritable errno family (guarded_store_write above)
+                guarded_store_write(
+                    self.path,
+                    lambda: atomic_dump_json(self.path, self.to_json(),
+                                             prefix=".store."),
+                    where="serve.store.flush")
             finally:
                 if lock_f is not None:
                     lock_f.close()  # releases the flock
@@ -407,7 +525,7 @@ class ScheduleStore:
             t = rec.get("provenance", {}).get("tenant")
             if t:
                 tenants.add(t)
-        return {
+        out = {
             "path": self.path,
             "fingerprints": len(self.entries),
             "records": len(self),
@@ -416,6 +534,10 @@ class ScheduleStore:
             "tenants": sorted(tenants),
             "skipped_on_load": self.skipped,
         }
+        ro = store_readonly(self.path) if self.path else None
+        if ro is not None:
+            out["readonly"] = ro
+        return out
 
 
 def open_store(path: Optional[str], **kwargs) -> "ScheduleStore":

@@ -8,6 +8,16 @@ new complete file, never a torn write, and the rename only lands after
 the bytes are durably on disk.  Factored out of fault/checkpoint.py
 (where it was born) when the serving store would otherwise have grown a
 third copy.
+
+Because every durable writer funnels through here, this module is also
+THE injectable I/O seam for hostile-filesystem chaos
+(fault/fsinject.py): an installed backend gets a checkpoint before each
+write/fsync/link/replace, may serve a stale read once, and may skew the
+mtimes the lease protocol observes (serve/lease.py).  With no backend
+installed — the production default — every hook is a no-op branch on a
+None global.  ``$TENZING_FSINJECT`` lazily installs a backend on first
+use, so subprocess fleet members inherit a chaos run's faults without
+argv plumbing.
 """
 
 from __future__ import annotations
@@ -15,7 +25,64 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Any, Dict
+from typing import Any, Dict, Optional
+
+FSINJECT_ENV = "TENZING_FSINJECT"
+
+_io_backend: Optional[Any] = None
+_env_checked = False
+
+
+def set_io_backend(backend: Optional[Any]) -> None:
+    """Install (or with None, remove) the fault-injecting I/O backend —
+    fault/fsinject.py is the only production caller."""
+    global _io_backend, _env_checked
+    _io_backend = backend
+    _env_checked = True
+
+
+def io_backend() -> Optional[Any]:
+    """The active backend, lazily installed from ``$TENZING_FSINJECT``
+    exactly once.  A malformed env spec raises loudly on the first write
+    — a chaos run that silently injects nothing proves nothing."""
+    global _env_checked
+    if not _env_checked:
+        _env_checked = True
+        if os.environ.get(FSINJECT_ENV):
+            from tenzing_tpu.fault.fsinject import install_from_env
+
+            install_from_env()
+    return _io_backend
+
+
+def _check(op: str, path: str) -> None:
+    b = io_backend()
+    if b is not None:
+        b.check(op, path)
+
+
+def io_getmtime(path: str) -> float:
+    """``os.path.getmtime`` as *observed* through the seam: an installed
+    backend may skew or coarsen it — the lease protocol's expiry checks
+    read clocks through here so chaos can model NFS/FAT timestamp
+    behavior (serve/lease.py)."""
+    t = os.path.getmtime(path)
+    b = io_backend()
+    return b.observe_mtime(path, t) if b is not None else t
+
+
+def read_json(path: str):
+    """``json.load(open(path))`` through the seam: an installed backend
+    may serve the file's *previous* complete content once (NFS
+    attribute-cache staleness).  Raises OSError/ValueError exactly like
+    the plain read."""
+    b = io_backend()
+    if b is not None:
+        doc = b.maybe_stale_json(path)
+        if doc is not None:
+            return doc
+    with open(path) as f:
+        return json.load(f)
 
 
 def fsync_dir(path: str) -> None:
@@ -52,11 +119,16 @@ def publish_sealed(directory: str, make_name, text: str) -> str:
         name = make_name()
         final = os.path.join(directory, name)
         tmp = final + ".tmp"
+        _check("write", final)
         with open(tmp, "w") as f:
             f.write(text)
             f.flush()
+            _check("fsync", final)
             os.fsync(f.fileno())
         try:
+            # the torn-rename kill point: temp bytes durable, link not
+            # yet landed — the crash the sealed formats must survive
+            _check("link", final)
             os.link(tmp, final)
         except FileExistsError:
             continue
@@ -80,12 +152,16 @@ def atomic_dump_json(path: str, doc: Dict[str, Any],
     after the rename so the publish itself is durable."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
+    _check("write", path)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix, suffix=".tmp")
     try:
         with os.fdopen(fd, "w") as f:
             json.dump(doc, f, sort_keys=True)
             f.flush()
+            _check("fsync", path)
             os.fsync(f.fileno())
+        # the torn-rename kill point (see publish_sealed)
+        _check("replace", path)
         os.replace(tmp, path)
         fsync_dir(d)
     except BaseException:

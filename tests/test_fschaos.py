@@ -1,0 +1,52 @@
+"""Chaos acceptance harness smoke (fault/fschaos.py; ISSUE 19): the
+unwritable drill — burst ENOSPC latches the store read-only, the
+store_unwritable alert fires, claims pause, space 'frees', the probe
+clears the latch and the alert resolves — is fast and deterministic,
+so it runs in tier 1.  The full fleet phase (real supervisor + seeded
+fs faults + member SIGKILL) is the slow acceptance gate."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fschaos(tmp_path, *argv, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TENZING_FSINJECT", None)
+    return subprocess.run(
+        [sys.executable, "-m", "tenzing_tpu.fault.fschaos",
+         "--workdir", str(tmp_path / "chaos"), *argv],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_unwritable_drill_fires_and_resolves(tmp_path):
+    """ENOSPC burst -> read-only latch -> alert fires -> daemon pauses;
+    space freed -> probe clears the latch -> alert resolves."""
+    p = _fschaos(tmp_path, "--skip-fleet", "--seed", "4242")
+    assert p.returncode == 0, p.stdout + p.stderr
+    verdict = json.loads(p.stdout.strip().splitlines()[-1])
+    assert verdict["kind"] == "fschaos_verdict" and verdict["ok"]
+    drill = verdict["drill"]
+    assert drill["fired"] and drill["resolved"]
+    assert drill["probe_write_denials"] > 0  # the outage was real
+
+
+@pytest.mark.slow
+def test_fleet_survives_hostile_fs_with_sigkill(tmp_path):
+    """One seeded hostile-fs fleet run (the quick acceptance shape the
+    CI chaos smoke also drives): supervisor + members under injected
+    EIO/ENOSPC/torn-rename/skew, a member SIGKILLed mid-drain — no
+    acknowledged-record loss, exactly-once effect, service answers."""
+    p = _fschaos(tmp_path, "--quick", "--seed", "777", timeout=560)
+    assert p.returncode == 0, p.stdout + p.stderr
+    verdict = json.loads(p.stdout.strip().splitlines()[-1])
+    inv = verdict["invariants"]
+    assert inv["no_record_loss"] and inv["exactly_once"]
+    assert inv["service_answered"]
+    assert inv["unwritable_fired_and_resolved"]

@@ -1,0 +1,165 @@
+"""Hostile-filesystem fault injection (fault/fsinject.py; ISSUE 19):
+the seeded spec grammar, identity-keyed draw determinism, each fault
+kind's behavior through the utils/atomic.py seam, max-fires bursts, and
+the env-install path subprocess fleets inherit."""
+
+import errno
+import json
+import os
+
+import pytest
+
+from tenzing_tpu.fault import fsinject
+from tenzing_tpu.fault.fsinject import (
+    FsInjectSpec,
+    InjectedTornRename,
+    format_fs_specs,
+    parse_fs_specs,
+)
+from tenzing_tpu.utils import atomic
+from tenzing_tpu.utils.atomic import (
+    atomic_dump_json,
+    io_getmtime,
+    read_json,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_backend():
+    """Every test starts and ends with the well-behaved filesystem."""
+    fsinject.uninstall()
+    yield
+    fsinject.uninstall()
+
+
+# -- spec grammar ------------------------------------------------------------
+
+def test_parse_fs_specs_golden():
+    specs = parse_fs_specs("eio:0.5:7,mtime_skew:1.0:11:2.5")
+    assert specs == [FsInjectSpec("eio", 0.5, 7),
+                     FsInjectSpec("mtime_skew", 1.0, 11, 2.5)]
+
+
+def test_parse_fs_specs_loud_errors():
+    """A typo'd chaos spec must fail loudly — silently injecting nothing
+    would make a green hostile-fs run meaningless."""
+    with pytest.raises(ValueError):
+        parse_fs_specs("eioo:0.5:7")        # unknown kind
+    with pytest.raises(ValueError):
+        parse_fs_specs("eio:1.5:7")         # rate out of range
+    with pytest.raises(ValueError):
+        parse_fs_specs("eio:0.5")           # missing seed
+    with pytest.raises(ValueError):
+        parse_fs_specs("")                  # empty
+
+
+def test_format_fs_specs_roundtrip():
+    text = "eio:0.5:7,torn_rename:0.1:3:1,mtime_coarse:1.0:9:2"
+    assert format_fs_specs(parse_fs_specs(text)) == text
+
+
+# -- draw determinism --------------------------------------------------------
+
+def test_draws_are_identity_keyed_and_replayable(tmp_path):
+    """The same writes against the same filenames fire the same faults
+    under the same seed — a chaos run replays; a different seed is a
+    different schedule."""
+    def fire_pattern(seed):
+        b = fsinject.FsInjectBackend(parse_fs_specs(f"eio:0.4:{seed}"))
+        out = []
+        for n in range(24):
+            try:
+                b.check("write", str(tmp_path / "seg-x.jsonl"))
+                out.append(False)
+            except OSError:
+                out.append(True)
+        return out
+
+    a, b2 = fire_pattern(7), fire_pattern(7)
+    assert a == b2 and any(a)
+    assert fire_pattern(8) != a
+
+
+def test_max_fires_bounds_the_burst(tmp_path):
+    """An integer param on eio/enospc/stale_read caps total fires: the
+    burst-then-recover schedule the unwritable drill scripts."""
+    b = fsinject.install("enospc:1.0:3:2")
+    fired = 0
+    for _ in range(10):
+        try:
+            b.check("write", str(tmp_path / "f.json"))
+        except OSError as e:
+            assert e.errno == errno.ENOSPC
+            fired += 1
+    assert fired == 2 and b.injected["enospc"] == 2
+
+
+# -- the seam, kind by kind --------------------------------------------------
+
+def test_eio_fires_on_write_through_seam(tmp_path):
+    fsinject.install("eio:1.0:5:1")
+    with pytest.raises(OSError) as ei:
+        atomic_dump_json(str(tmp_path / "doc.json"), {"k": 1})
+    assert ei.value.errno == errno.EIO
+    # burst exhausted: the retry lands and the file is whole
+    atomic_dump_json(str(tmp_path / "doc.json"), {"k": 1})
+    assert json.load(open(tmp_path / "doc.json")) == {"k": 1}
+
+
+def test_torn_rename_raise_mode_leaves_temp_bytes(tmp_path):
+    """param=1: the publish step raises AFTER the temp bytes landed —
+    the in-process stand-in for dying between fsync and link."""
+    fsinject.install("torn_rename:1.0:5:1")
+    path = str(tmp_path / "doc.json")
+    with pytest.raises(InjectedTornRename):
+        atomic_dump_json(path, {"k": 1})
+    assert not os.path.exists(path)  # never published
+    fsinject.uninstall()
+    atomic_dump_json(path, {"k": 2})
+    assert json.load(open(path)) == {"k": 2}
+
+
+def test_stale_read_serves_previous_content_once(tmp_path):
+    """An injected stale read returns the *superseded* complete JSON,
+    at most once per replaced version — NFS attribute-cache staleness,
+    the lie the lease nonce re-read must survive."""
+    path = str(tmp_path / "lease.json")
+    fsinject.install("stale_read:1.0:5")
+    atomic_dump_json(path, {"v": 1})
+    atomic_dump_json(path, {"v": 2})  # replace: v1 snapshotted
+    assert read_json(path) == {"v": 1}   # the stale lie
+    assert read_json(path) == {"v": 2}   # served once; truth thereafter
+
+
+def test_mtime_skew_and_coarse_shift_observed_clock(tmp_path):
+    path = str(tmp_path / "lease.json")
+    atomic_dump_json(path, {"v": 1})
+    real = os.path.getmtime(path)
+    fsinject.install("mtime_skew:1.0:5:3.5")
+    assert io_getmtime(path) == pytest.approx(real - 3.5)
+    fsinject.install("mtime_coarse:1.0:5:2")
+    seen = io_getmtime(path)
+    assert seen <= real and seen % 2 == 0
+
+
+def test_env_install_is_lazy_and_inherited(tmp_path, monkeypatch):
+    """utils/atomic.py installs from $TENZING_FSINJECT on first write:
+    the subprocess-fleet inheritance path, no argv plumbing."""
+    monkeypatch.setenv(fsinject.FSINJECT_ENV, "eio:1.0:5:1")
+    # simulate a fresh process: no backend yet, env not consulted
+    atomic.set_io_backend(None)
+    atomic._env_checked = False
+    with pytest.raises(OSError):
+        atomic_dump_json(str(tmp_path / "doc.json"), {"k": 1})
+    assert fsinject.installed() is not None
+    assert fsinject.installed().injected["eio"] == 1
+
+
+def test_injected_counters_per_kind(tmp_path):
+    b = fsinject.install("eio:1.0:5:1,enospc:1.0:5:1")
+    for _ in range(2):
+        try:
+            atomic_dump_json(str(tmp_path / "doc.json"), {"k": 1})
+        except OSError:
+            pass
+    assert b.injected["eio"] + b.injected["enospc"] == 2

@@ -1,0 +1,167 @@
+"""Lease epoch fencing on hostile clocks (serve/lease.py; ISSUE 19
+satellite): coarse or skewed observed mtimes let a rival reclaim a LIVE
+lease — the first half documents that hole (it is real and allowed);
+the second half proves the fencing-token registry catches the
+superseded holder before any guarded effect lands."""
+
+import json
+import os
+
+import pytest
+
+from tenzing_tpu.fault import fsinject
+from tenzing_tpu.fault.errors import FencedWriteError
+from tenzing_tpu.serve.lease import (
+    LeaseFile,
+    check_epoch,
+    epoch_registry_of,
+    issued_epoch,
+)
+from tenzing_tpu.utils import atomic
+
+
+# floors the observed mtime to the minute AND skews it a full minute
+# back: any sub-minute TTL sees every lease as expired, deterministically
+# (a plain coarse-only spec would flake when wall-clock sits near a
+# granularity boundary)
+HOSTILE_CLOCK = "mtime_coarse:1.0:{s}:60,mtime_skew:1.0:{s}:60"
+
+
+@pytest.fixture(autouse=True)
+def _clean_backend():
+    fsinject.uninstall()
+    yield
+    fsinject.uninstall()
+
+
+def _lease(tmp_path, owner, ttl=30.0):
+    return LeaseFile(str(tmp_path / "lease-item.json"), owner,
+                     ttl_secs=ttl)
+
+
+# -- the hole (pre-fencing behavior, documented) ------------------------------
+
+def test_coarse_clock_reclaims_a_live_lease(tmp_path):
+    """THE HOLE: on a coarse/skewed filesystem the expiry clock lies,
+    so a rival legitimately reclaims a lease whose holder is alive and
+    heartbeating.  The protocol allows this — expiry decisions can only
+    trust the observed clock — which is exactly why effects must be
+    fenced rather than the claim prevented."""
+    a = _lease(tmp_path, "alice")
+    info_a = a.claim()
+    assert info_a is not None and not info_a.reclaimed
+
+    fsinject.install(HOSTILE_CLOCK.format(s=11))
+    b = _lease(tmp_path, "bob")
+    info_b = b.claim()
+    assert info_b is not None and info_b.reclaimed  # live lease stolen
+    assert info_b.prev_owner == "alice"
+
+    # the nonce re-read catches alice at her NEXT heartbeat...
+    assert not a.owns() and not a.renew()
+    # ...but between heartbeats she believes she holds the lease: that
+    # window is what the epoch fence closes (tests below)
+
+
+def test_stale_read_defeats_the_nonce_check_alone(tmp_path):
+    """THE DEEPER HOLE: an NFS-style stale read can serve the zombie
+    her OWN superseded payload, so even the nonce re-read says 'still
+    yours'.  owns() lies; only the fence tells the truth."""
+    a = _lease(tmp_path, "alice")
+    a.claim()
+    stale_payload = json.load(open(a.path))  # alice's live payload
+
+    fsinject.install(HOSTILE_CLOCK.format(s=13))
+    b = _lease(tmp_path, "bob")
+    assert b.claim().reclaimed
+
+    class _StaleOnce:
+        """Serve alice's superseded lease payload to one read — the
+        seam protocol's read-path checkpoint, canned."""
+
+        def __init__(self):
+            self.served = False
+
+        def check(self, op, path):
+            pass
+
+        def observe_mtime(self, path, mtime):
+            return mtime
+
+        def maybe_stale_json(self, path):
+            if not self.served and path == a.path:
+                self.served = True
+                return stale_payload
+            return None
+
+    atomic.set_io_backend(_StaleOnce())
+    try:
+        assert a.owns()  # the lie: nonce check passes on stale bytes
+        with pytest.raises(FencedWriteError):
+            a.check_fence()  # the fence is not fooled
+    finally:
+        atomic.set_io_backend(None)
+
+
+# -- the fix (epoch fencing) --------------------------------------------------
+
+def test_epoch_fences_zombie_and_passes_holder(tmp_path):
+    a = _lease(tmp_path, "alice")
+    assert a.claim().epoch == 1
+
+    fsinject.install(HOSTILE_CLOCK.format(s=17))
+    b = _lease(tmp_path, "bob")
+    assert b.claim().epoch == 2
+    fsinject.uninstall()
+
+    assert issued_epoch(a.path) == 2
+    b.check_fence()  # live holder: no-op
+    with pytest.raises(FencedWriteError):
+        a.check_fence()  # superseded holder: refused
+    with pytest.raises(FencedWriteError):
+        check_epoch(a.path, 1)  # same check, functional form
+
+
+def test_purge_restarts_epochs_for_fresh_work(tmp_path):
+    """The completing holder purges the registry once the guarded
+    effect landed: a fresh item at the same lease path restarts epochs
+    from 1 rather than inheriting a dead item's history."""
+    a = _lease(tmp_path, "alice")
+    a.claim()
+    a.release()
+    a.purge_epochs()
+    assert issued_epoch(a.path) == 0
+    assert not os.path.isdir(epoch_registry_of(a.path))
+
+    b = _lease(tmp_path, "bob")
+    assert b.claim().epoch == 1
+
+
+def test_unfenced_claim_degrades_to_nonce_checks(tmp_path):
+    """A claim whose epoch marker never landed (registry unwritable)
+    still holds the lease; check_fence() is then a no-op — fencing
+    degrades, it never blocks the claim itself."""
+    a = _lease(tmp_path, "alice")
+    info = a.claim()
+    assert info is not None
+    a.epoch = None  # as if _record_epoch had failed
+    a.check_fence()  # no raise: falls back to nonce protection
+    assert a.owns()
+
+
+def test_registry_trims_to_epoch_keep(tmp_path):
+    """Successive reclaim generations must not grow the registry without
+    bound; only the newest EPOCH_KEEP markers survive."""
+    from tenzing_tpu.serve.lease import EPOCH_KEEP
+
+    path = str(tmp_path / "lease-item.json")
+    fsinject.install(HOSTILE_CLOCK.format(s=19))
+    last = None
+    for g in range(EPOCH_KEEP + 4):
+        holder = LeaseFile(path, f"gen-{g}", ttl_secs=30.0)
+        last = holder.claim()
+    assert last.epoch == EPOCH_KEEP + 4
+    markers = [n for n in os.listdir(epoch_registry_of(path))
+               if n.startswith("c-")]
+    assert len(markers) <= EPOCH_KEEP
+    assert issued_epoch(path) == EPOCH_KEEP + 4

@@ -443,3 +443,128 @@ def test_follow_renders_firing_lines(tree):
     lines = firing_lines([store], [queue])
     assert len(lines) == 1
     assert lines[0].startswith("ALERT  [page] slo_burn burn:")
+
+
+# -- hostile-filesystem rules (ISSUE 19) -------------------------------------
+
+RO = {"errno": 28, "error": "[Errno 28] injected enospc", "reason": "write",
+      "latched_at": NOW}
+
+
+def _ro_status(d, owner, state="paused", ro=RO, kind="drain_daemon",
+               now=NOW):
+    doc = {"kind": kind, "owner": owner, "state": state,
+           "heartbeat_at": now}
+    if ro is not None:
+        doc["store_readonly"] = ro
+    json.dump(doc, open(os.path.join(d, f"status-{owner}.json"), "w"))
+
+
+def _ro_snap(d, owner, seq, ro=RO, now=NOW):
+    doc = {"kind": "metrics_snapshot", "owner": owner, "seq": seq,
+           "written_at": now - (10 - seq), "state": "serving",
+           "metrics": {"counters": {}, "gauges": {}, "histograms": {}},
+           "tracer": {"dropped_spans": 0, "dropped_events": 0}}
+    if ro is not None:
+        doc["store_readonly"] = ro
+    json.dump(doc, open(os.path.join(d, f"metrics-{owner}-{seq}.json"),
+                        "w"))
+
+
+def test_store_unwritable_fires_from_daemon_status(tree):
+    """Daemons publish no snapshot ring: the latch on their status doc
+    alone must page."""
+    store, queue = tree
+    _ro_status(queue, "d1")
+    alerts = evaluate([store], [queue], now=NOW)
+    assert [a.key for a in alerts] == ["store_unwritable:d1"]
+    a = alerts[0]
+    assert a.severity == "page"
+    assert a.value == {"errno": 28, "reason": "write"}
+    assert "read-only" in a.message and "probe" in a.message
+
+
+def test_store_unwritable_one_alert_per_owner(tree):
+    """A latched serve loop carries the latch on BOTH its snapshot ring
+    and its status doc — one alert, not two."""
+    store, queue = tree
+    _ro_snap(store, "loop", 0)
+    _ro_status(store, "loop", state="serving", kind="serve_loop")
+    alerts = [a for a in evaluate([store], [queue], now=NOW)
+              if a.rule == "store_unwritable"]
+    assert len(alerts) == 1 and alerts[0].subject == "loop"
+
+
+def test_store_unwritable_stopped_owner_skipped(tree):
+    store, queue = tree
+    _ro_status(queue, "d1", state="stopped")
+    _ro_snap(store, "loop", 0)
+    _ro_snap(store, "loop", 1)
+    json.dump(dict(json.load(open(os.path.join(
+        store, "metrics-loop-1.json"))), state="stopped"),
+        open(os.path.join(store, "metrics-loop-1.json"), "w"))
+    assert evaluate([store], [queue], now=NOW) == []
+
+
+def test_store_unwritable_fires_then_resolves_in_ledger(tree, tmp_path):
+    """The fschaos drill's alert contract, in miniature: latch -> fire;
+    probe write lands, latch clears -> resolve."""
+    store, queue = tree
+    book = AlertBook(str(tmp_path / "alerts.json"), resolve_hold_secs=0.0)
+    _ro_status(queue, "d1")
+    doc = book.apply(evaluate([store], [queue], now=NOW), now=NOW)
+    assert doc["firing"] == ["store_unwritable:d1"]
+    _ro_status(queue, "d1", ro=None, state="idle")
+    doc = book.apply(evaluate([store], [queue], now=NOW + 5), now=NOW + 5)
+    assert doc["firing"] == []
+    assert doc["alerts"]["store_unwritable:d1"]["state"] == "resolved"
+
+
+def test_store_damage_rate_fires_on_ring_growth(tree):
+    store, queue = tree
+    _snap_raw(store, "loop", 0,
+              counters={"serve.store.checksum_failed": 0})
+    _snap_raw(store, "loop", 3,
+              counters={"serve.store.checksum_failed": 4,
+                        "serve.store.segment_quarantined": 1})
+    alerts = evaluate([store], [queue], now=NOW)
+    assert [a.key for a in alerts] == ["store_damage_rate:loop"]
+    a = alerts[0]
+    assert a.severity == "ticket"
+    assert a.value == {"checksum_failed": 4, "segment_quarantined": 1}
+    assert "fsck" in a.message
+
+
+def test_store_damage_rate_flat_and_reset(tree):
+    store, queue = tree
+    # flat counters: old damage is not NEW damage
+    _snap_raw(store, "loop", 0,
+              counters={"serve.store.checksum_failed": 4})
+    _snap_raw(store, "loop", 3,
+              counters={"serve.store.checksum_failed": 4})
+    assert evaluate([store], [queue], now=NOW) == []
+    # a counter reset (restart inside the ring) reads as "growth since
+    # the reset", same rule as tenant_shed
+    _snap_raw(store, "loop", 3,
+              counters={"serve.store.checksum_failed": 3})
+    alerts = evaluate([store], [queue], now=NOW)
+    assert [a.key for a in alerts] == ["store_damage_rate:loop"]
+    assert alerts[0].value == {"checksum_failed": 3}
+
+
+def test_backlog_summary_excludes_quarantined_members(tree):
+    """A crash-looped member leaves a stale never-'stopped' status doc
+    behind; the supervisor's open breaker names it, and its phantom
+    capacity must not shrink the recommended fleet."""
+    store, queue = tree
+    json.dump({"kind": "supervisor", "owner": "fleet-0",
+               "state": "supervising", "heartbeat_at": NOW,
+               "breakers": {"w1": {"state": "open"},
+                            "w2": {"state": "closed"}}},
+              open(os.path.join(queue, "status-fleet-0.json"), "w"))
+    _daemon_status(queue, "w1", [{"outcome": "completed", "wall_s": 1.0}])
+    _daemon_status(queue, "w2", [{"outcome": "completed", "wall_s": 1.0}])
+    bl = backlog_summary([store], [queue], max_daemons=0)
+    assert bl["daemons"] == 1  # w2 only
+    assert bl["quarantined_daemons"] == 1
+    assert bl["drain_per_s"] == 1.0  # w1's stale doc contributes nothing

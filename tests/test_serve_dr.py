@@ -1,0 +1,241 @@
+"""Disaster recovery for the serve plane (serve/dr.py; ISSUE 19):
+backup -> rm -rf -> restore round-trips byte-identical, merge-restore
+into a live store is a superset of both sides, tampered generations
+are refused without --force, and fsck's exit codes are a CI gate
+(clean / damaged / unreadable) with orphan adoption its only write."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tenzing_tpu.bench.driver import DriverRequest, graph_for
+from tenzing_tpu.serve import dr
+from tenzing_tpu.serve.fingerprint import fingerprint_of
+from tenzing_tpu.serve.segments import SegmentedStore
+from tenzing_tpu.serve.store import open_store
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def spmv():
+    """(graph, fingerprints, sequences) — same neighborhood as
+    tests/test_serve_segments.py."""
+    from tenzing_tpu.core.platform import Platform
+    from tenzing_tpu.core.state import State
+
+    req = DriverRequest(workload="spmv", m=512)
+    g, _ = graph_for(req)
+
+    def drive(picks, n_lanes=2):
+        plat = Platform.make_n_lanes(n_lanes)
+        st = State(g)
+        i = 0
+        while not st.is_terminal():
+            ds = st.get_decisions(plat)
+            st = st.apply(ds[picks[i % len(picks)] % len(ds)])
+            i += 1
+        return st.sequence
+
+    fps = {
+        "a": fingerprint_of(req),
+        "b": fingerprint_of(DriverRequest(workload="spmv", m=500)),
+        "c": fingerprint_of(DriverRequest(workload="spmv", m=100000)),
+    }
+    seqs = [drive(p) for p in ([0], [1, 2, 0], [2, 1, 0])]
+    return g, fps, seqs
+
+
+def _seed_store(store_dir, spmv, keys=("a", "b")):
+    _, fps, seqs = spmv
+    s = SegmentedStore(str(store_dir))
+    for i, k in enumerate(keys):
+        s.add(fps[k], seqs[i % len(seqs)], pct50_us=10.0 + i,
+              vs_naive=2.0, verified=True)
+    s.flush()
+    return s
+
+
+def _tree_bytes(store_dir):
+    """rel-path -> content for every store file (segments + manifest)."""
+    out = {}
+    for root, _dirs, names in os.walk(store_dir):
+        for n in names:
+            p = os.path.join(root, n)
+            rel = os.path.relpath(p, store_dir)
+            if rel.startswith("backups") or rel.endswith(".lock"):
+                continue  # lock files are lease artifacts, not content
+            with open(p, "rb") as f:
+                out[rel] = f.read()
+    return out
+
+
+def _one_segment(store_dir):
+    segdir = os.path.join(str(store_dir), "segments")
+    return os.path.join(segdir, sorted(
+        n for n in os.listdir(segdir) if n.endswith(".jsonl"))[0])
+
+
+# -- round trip ---------------------------------------------------------------
+
+def test_backup_rm_restore_byte_identical(tmp_path, spmv):
+    """The acceptance drill: backup, destroy the store, restore — every
+    catalogued file comes back byte-for-byte, and fsck gates clean."""
+    store = tmp_path / "store"
+    _seed_store(store, spmv)
+    before = _tree_bytes(store)
+
+    cat = dr.backup_store(str(store), out_dir=str(tmp_path / "bk"))
+    assert cat["n_files"] == len(cat["files"]) >= 2  # segments + manifest
+
+    shutil.rmtree(store)
+    out = dr.restore_store(str(store), cat["generation"])
+    assert out["mode"] == "verbatim"
+    assert _tree_bytes(store) == before
+
+    doc = dr.fsck_store(str(store), check_backups=False)
+    assert doc["ok"] and doc["rc"] == dr.RC_CLEAN and doc["records"] == 2
+
+
+def test_merge_restore_is_a_superset_of_both_sides(tmp_path, spmv):
+    """Restore into a LIVE store: records written after the snapshot
+    survive, records lost since the snapshot come back."""
+    _, fps, seqs = spmv
+    store = tmp_path / "store"
+    _seed_store(store, spmv, keys=("a",))
+    cat = dr.backup_store(str(store), out_dir=str(tmp_path / "bk"))
+
+    # post-snapshot progress that a verbatim restore would clobber
+    live = SegmentedStore(str(store))
+    live.add(fps["b"], seqs[1], pct50_us=5.0, vs_naive=3.0, verified=True)
+    live.flush()
+
+    out = dr.restore_store(str(store), cat["generation"])
+    assert out["mode"] == "merge"
+    after = open_store(str(store))
+    assert after.best(fps["a"].exact_digest) is not None  # snapshot side
+    assert after.best(fps["b"].exact_digest) is not None  # post-snapshot
+
+
+# -- tamper + refusal ---------------------------------------------------------
+
+def test_tampered_generation_refused_without_force(tmp_path, spmv):
+    store = tmp_path / "store"
+    _seed_store(store, spmv)
+    cat = dr.backup_store(str(store), out_dir=str(tmp_path / "bk"))
+    gen = cat["generation"]
+
+    # segments are captured by hard link: rewrite (not append) a copy so
+    # the damage stays inside the generation
+    victim = os.path.join(gen, sorted(
+        rel for rel in cat["files"] if rel.endswith(".jsonl"))[0])
+    blob = open(victim, "rb").read()
+    os.unlink(victim)
+    with open(victim, "wb") as f:
+        f.write(blob[:-7] + b"garbage")
+
+    verdict = dr.verify_backup(gen)
+    assert not verdict["ok"] and verdict["mismatched"]
+
+    shutil.rmtree(store)
+    with pytest.raises(dr.DrError):
+        dr.restore_store(str(store), gen)
+    out = dr.restore_store(str(store), gen, force=True)
+    assert out["damaged_skipped"]  # reported, not silently dropped
+
+
+def test_generation_without_catalog_is_an_aborted_backup(tmp_path):
+    gen = tmp_path / "bk" / "gen-123-1"
+    os.makedirs(gen / "segments")
+    with pytest.raises(dr.DrError):
+        dr.load_catalog(str(gen))
+    with pytest.raises(dr.DrError):
+        dr.restore_store(str(tmp_path / "store"), str(gen))
+
+
+# -- fsck ---------------------------------------------------------------------
+
+def test_fsck_exit_codes_clean_damaged_unreadable(tmp_path, spmv):
+    store = tmp_path / "store"
+    _seed_store(store, spmv)
+    assert dr.fsck_store(str(store), check_backups=False)["rc"] == \
+        dr.RC_CLEAN
+
+    # flip a byte inside a record line: sha256 mismatch = damage
+    seg = _one_segment(store)
+    blob = bytearray(open(seg, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(seg, "wb") as f:
+        f.write(blob)
+    doc = dr.fsck_store(str(store), check_backups=False)
+    assert doc["rc"] == dr.RC_DAMAGED and doc["errors"]
+
+    assert dr.fsck_store(str(tmp_path / "nope"),
+                         check_backups=False)["rc"] == dr.RC_UNREADABLE
+
+
+def test_fsck_adopts_orphan_segments(tmp_path, spmv):
+    """A published-but-unindexed segment (a writer that died between
+    publish and manifest update) is a warning read-only, and joins the
+    manifest under --adopt — fsck's only permitted write."""
+    _, fps, seqs = spmv
+    store = tmp_path / "store"
+    _seed_store(store, spmv, keys=("a",))
+
+    donor = tmp_path / "donor"
+    d = SegmentedStore(str(donor))
+    d.add(fps["c"], seqs[2], pct50_us=7.0, vs_naive=4.0, verified=True)
+    d.flush()
+    shutil.copy2(_one_segment(donor),
+                 os.path.join(str(store), "segments",
+                              os.path.basename(_one_segment(donor))))
+
+    doc = dr.fsck_store(str(store), check_backups=False)
+    assert doc["orphan_segments"] and doc["rc"] == dr.RC_CLEAN
+
+    doc = dr.fsck_store(str(store), adopt=True, check_backups=False)
+    assert doc["adopted_orphans"]
+    doc = dr.fsck_store(str(store), check_backups=False)
+    assert not doc["orphan_segments"]
+    assert open_store(str(store)).best(fps["c"].exact_digest) is not None
+
+
+def test_fsck_stamp_feeds_report_follow(tmp_path, spmv):
+    store = tmp_path / "store"
+    _seed_store(store, spmv)
+    dr.fsck_store(str(store), stamp=True, check_backups=False)
+    doc = json.load(open(os.path.join(str(store), dr.FSCK_STAMP)))
+    assert doc["kind"] == "fsck" and doc["ok"] and doc["rc"] == 0
+
+
+# -- the CLI gate -------------------------------------------------------------
+
+def test_serve_cli_backup_restore_fsck_round_trip(tmp_path, spmv):
+    """The operator surface: ``serve backup`` then ``serve restore``
+    (latest generation by default) then ``serve fsck`` exiting 0."""
+    store = tmp_path / "store"
+    _seed_store(store, spmv)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def serve(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "tenzing_tpu.serve", *argv],
+            cwd=REPO, env=env, capture_output=True, text=True)
+
+    # --out: the default generations root lives INSIDE the store, and
+    # this drill is about losing the store
+    p = serve("backup", "--store", str(store), "--out",
+              str(tmp_path / "bk"))
+    assert p.returncode == 0, p.stderr
+    shutil.rmtree(store)
+    p = serve("restore", "--store", str(store), "--out",
+              str(tmp_path / "bk"))
+    assert p.returncode == 0, p.stderr
+    p = serve("fsck", "--store", str(store), "--stamp", "--no-backups")
+    assert p.returncode == 0, p.stderr
+    assert json.load(open(os.path.join(
+        str(store), dr.FSCK_STAMP)))["ok"]
