@@ -209,6 +209,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="relative tolerance of the result-integrity "
                          "gate's winner-vs-naive output comparison (loose "
                          "enough for bf16-staging menu choices)")
+    ap.add_argument("--search-workers", type=int, default=0, metavar="N",
+                    help="distributed search fleet "
+                         "(docs/performance.md, 'Distributed search'): run "
+                         "the climb jobs across N solver worker processes "
+                         "over the file control plane, with this process "
+                         "as the single measurement owner; 1 (with "
+                         "--measure-batch 1) is the serialized inline "
+                         "path, bit-identical to the legacy climb loop; "
+                         "0 disables the fleet entirely")
+    ap.add_argument("--measure-batch", type=int, default=0, metavar="K",
+                    help="fuse up to K candidate schedules from distinct "
+                         "workers into one device measurement round "
+                         "(grouped batch seeds keep each worker's paired "
+                         "permutation stream intact), with prefetch hints "
+                         "compiling round i+1 during round i; 0 disables "
+                         "the fleet")
     return ap
 
 

@@ -285,6 +285,7 @@ class EmpiricalBenchmarker:
         opts: Optional[BenchOpts] = None,
         seed: int = 0,
         times_out: Optional[List[List[float]]] = None,
+        group_seeds: Optional[List[Tuple[int, int]]] = None,
     ) -> List[List[float]]:
         """Raw per-iteration times, aligned by iteration index: ``times[i][k]``
         is schedule i's secs-per-sample in iteration k, and iteration k visits
@@ -294,9 +295,36 @@ class EmpiricalBenchmarker:
 
         ``times_out`` (a list of ``len(orders)`` empty lists) is filled in
         place as measurements land, so a signal handler can snapshot partial
-        data from a long batch (the DFS partial-dump contract, trap.py)."""
+        data from a long batch (the DFS partial-dump contract, trap.py).
+
+        ``group_seeds`` — ``[(n_orders, seed), ...]`` partitioning ``orders``
+        into consecutive groups, each shuffled by its OWN persistent
+        ``Random(group_seed)``: a group's per-iteration visit order depends
+        only on its own ``(group_orders, group_seed)``, bit-identical to a
+        solo ``benchmark_batch_times(group_orders, seed=group_seed)`` call.
+        This is how the search fleet's measurement owner fuses K candidate
+        pairs from different worker processes into one device round without
+        perturbing any worker's reproducibility (search/fleet.py) — the
+        global permutation of the old single-seed path would entangle every
+        group's visit order with its co-scheduled strangers.  ``None`` means
+        one group ``(len(orders), seed)`` — exactly the historical
+        behavior."""
         opts = opts if opts is not None else BenchOpts()
-        rng = _random.Random(seed)
+        groups = (list(group_seeds) if group_seeds is not None
+                  else [(len(orders), seed)])
+        if (any(n <= 0 for n, _ in groups)
+                or sum(n for n, _ in groups) != len(orders)):
+            raise ValueError(
+                "group_seeds must partition orders into non-empty runs: "
+                f"{groups} vs {len(orders)} orders")
+        # one persistent RNG per group: reproducibility is per-group, never
+        # a function of what else shares the device round
+        group_rngs = [_random.Random(s) for _, s in groups]
+        group_spans: List[range] = []
+        at = 0
+        for n, _ in groups:
+            group_spans.append(range(at, at + n))
+            at += n
         # validate before the (expensive) compile-all warmup; non-empty inner
         # lists would shift iteration indices and silently break the paired
         # -comparison alignment
@@ -306,7 +334,8 @@ class EmpiricalBenchmarker:
             raise ValueError("times_out must have one EMPTY list per order")
         tr = get_tracer()
         with tr.span("bench.batch", n_orders=len(orders),
-                     n_iters=opts.n_iters, seed=seed) as sp:
+                     n_iters=opts.n_iters, seed=seed,
+                     n_groups=len(groups)) as sp:
             runners = [self._runner_for(o) for o in orders]
             with tr.span("bench.batch_warm", n_orders=len(orders)):
                 for r, _ in runners:
@@ -316,12 +345,14 @@ class EmpiricalBenchmarker:
                 times_out if times_out is not None else [[] for _ in orders]
             )
             for _ in range(opts.n_iters):
-                perm = list(range(len(orders)))
-                rng.shuffle(perm)  # seeded: identical visit order on every host
-                for i in perm:
-                    run_n, fences = runners[i]
-                    t, n_samples[i] = self._measure(run_n, n_samples[i], opts, fences)
-                    times[i].append(t)
+                for span, rng in zip(group_spans, group_rngs):
+                    perm = list(span)
+                    rng.shuffle(perm)  # seeded: identical order on every host
+                    for i in perm:
+                        run_n, fences = runners[i]
+                        t, n_samples[i] = self._measure(
+                            run_n, n_samples[i], opts, fences)
+                        times[i].append(t)
             sp.set("fetch_overhead", self._overhead)
             get_metrics().counter("bench.measurements").inc(
                 opts.n_iters * len(orders))

@@ -136,6 +136,8 @@ class DriverRequest:
     synth_collectives: bool = False
     no_verify: bool = False
     verify_tol: float = 0.02
+    search_workers: int = 0
+    measure_batch: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         """A JSON-ready dict (the serve work-queue payload —
@@ -199,6 +201,57 @@ def alias_unpack_choice(op_name, choices):
     disciplines share."""
     want = ALIAS_UNPACK[op_name[-1]]
     return next((c for c in choices if c.endswith(want)), None)
+
+
+def generic_xla_prefer(op_name, choices):
+    """Workload-agnostic default policy: the plain XLA lowering when the
+    menu has one — the fleet's smoke-job prefer (safe on any workload)."""
+    return next((c for c in choices if c.endswith(".xla")), None)
+
+
+def halo_alias_prefer(op_name, choices):
+    """The halo climb policy: all-rdma + the aliased-unpack kernel map (the
+    measured r5 recipe — in-place ghost-shell writes per face,
+    MENU_INCUMBENT2/3).  Module-level so a fleet worker process can rebuild
+    it by name from the job spec (search/fleet.py resolve_prefer)."""
+    if op_name.startswith("xfer_"):
+        return next((c for c in choices if c.endswith(".rdma")), None)
+    if op_name.startswith("unpack_"):
+        hit = alias_unpack_choice(op_name, choices)
+        if hit is not None:
+            return hit
+    return next((c for c in choices if c.endswith(".xla")), None)
+
+
+def moe_bf16_prefer(op_name, choices):
+    """The moe climb policy: whole-chain staging choice — device-resident
+    bf16 transfers (the measured 10.97x winner); kernel choices default to
+    XLA."""
+    return next(
+        (c for c in choices if c.endswith(".bf16-rdma")),
+        next((c for c in choices if c.endswith(".xla")), None),
+    )
+
+
+def recorded_prefer(chosen: Dict[str, str]):
+    """The climb policy replicating a recorded winner's menu choices
+    (``chosen``: base op name -> ``".suffix"``) — the factory form of the
+    legacy closure, so a fleet worker can rebuild it from the job spec's
+    serialized ``chosen`` map."""
+
+    def prefer(op_name, choices):
+        want = chosen.get(op_name)
+        if want is not None:
+            c = next((c for c in choices if c.endswith(want)), None)
+            if c is not None:
+                return c
+        if op_name.startswith("xfer_"):
+            # a recorded host-staged transfer leaves no "xfer_*" vertex
+            # (the HostRoundTrip compound expands into spill/fetch)
+            return next((c for c in choices if c.endswith(".host")), None)
+        return next((c for c in choices if c.endswith(".xla")), None)
+
+    return prefer
 
 
 def metric_for(workload: str, args) -> str:
@@ -1377,9 +1430,11 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
     climb_cfg = []
 
     def recorded_prefer_and_lanes():
-        """(prefer, n_lanes) replicating the best recorded schedule's menu
-        choices — the climb starts in the recorded winner's kernel/engine
-        configuration and searches order/lane/flip moves from there."""
+        """(prefer, n_lanes, chosen) replicating the best recorded
+        schedule's menu choices — the climb starts in the recorded winner's
+        kernel/engine configuration and searches order/lane/flip moves from
+        there.  ``chosen`` rides along so a fleet job spec can serialize
+        the policy for a worker process (recorded_prefer rebuilds it)."""
         from tenzing_tpu.core.serdes import sequence_to_json
 
         js = sequence_to_json(recorded[0])
@@ -1390,34 +1445,17 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
                 base, suffix = n.rsplit(".", 1)
                 chosen.setdefault(base, "." + suffix)
 
-        def prefer(op_name, choices):
-            want = chosen.get(op_name)
-            if want is not None:
-                c = next((c for c in choices if c.endswith(want)), None)
-                if c is not None:
-                    return c
-            if op_name.startswith("xfer_"):
-                # a recorded host-staged transfer leaves no "xfer_*" vertex
-                # (the HostRoundTrip compound expands into spill/fetch)
-                return next((c for c in choices if c.endswith(".host")), None)
-            return next((c for c in choices if c.endswith(".xla")), None)
-
         lanes_used = [j.get("lane") for j in js if j.get("lane") is not None]
-        return prefer, (max(lanes_used) + 1 if lanes_used else 2)
+        return (recorded_prefer(chosen),
+                (max(lanes_used) + 1 if lanes_used else 2), chosen)
 
+    # each climb config carries its prefer SPEC (name + serialized chosen
+    # map) beside the callable, so the fleet can ship the policy to a
+    # worker process (search/fleet.py resolve_prefer rebuilds the same
+    # module-level functions — inline and worker execution agree
+    # decision-for-decision)
     if args.workload == "halo" and not args.smoke:
         from tenzing_tpu.models.halo_pipeline import HALO_PHASES
-
-        def alias_prefer(op_name, choices):
-            # all-rdma + the aliased-unpack kernel map (the measured r5
-            # recipe: in-place ghost-shell writes per face, MENU_INCUMBENT2/3)
-            if op_name.startswith("xfer_"):
-                return next((c for c in choices if c.endswith(".rdma")), None)
-            if op_name.startswith("unpack_"):
-                hit = alias_unpack_choice(op_name, choices)
-                if hit is not None:
-                    return hit
-            return next((c for c in choices if c.endswith(".xla")), None)
 
         # climbs: one seeded from the best RECORDED schedule's menu choices
         # (when a database is present — the cross-run memory), then the two
@@ -1430,38 +1468,59 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
         b1 = (rest * 4) // 7
         plat3 = Platform.make_n_lanes(3)
         climb_cfg = [
-            (plat3, HALO_PHASES, alias_prefer, None, b1),
-            (Platform.make_n_lanes(6), HALO_PHASES, alias_prefer, None,
-             rest - b1),
+            (plat3, HALO_PHASES, halo_alias_prefer, None, b1,
+             "halo_alias", None),
+            (Platform.make_n_lanes(6), HALO_PHASES, halo_alias_prefer, None,
+             rest - b1, "halo_alias", None),
         ]
         if b_rec:
-            rec_prefer, n_rec = recorded_prefer_and_lanes()
+            rec_prefer, n_rec, rec_chosen = recorded_prefer_and_lanes()
             climb_cfg.insert(
                 0,
                 (Platform.make_n_lanes(n_rec), HALO_PHASES, rec_prefer, None,
-                 b_rec),
+                 b_rec, "recorded", rec_chosen),
             )
     elif args.workload == "moe" and not args.smoke:
         from tenzing_tpu.models.moe_pipeline import PHASES as MOE_PHASES
 
-        def moe_prefer(op_name, choices):
-            # whole-chain staging choice: device-resident bf16 transfers (the
-            # measured 10.97x winner); kernel choices default to XLA
-            return next(
-                (c for c in choices if c.endswith(".bf16-rdma")),
-                next((c for c in choices if c.endswith(".xla")), None),
-            )
-
         b_rec = (args.climb_budget // 2) if recorded else 0
-        climb_cfg = [(plat, MOE_PHASES, moe_prefer, None,
-                      args.climb_budget - b_rec)]
+        climb_cfg = [(plat, MOE_PHASES, moe_bf16_prefer, None,
+                      args.climb_budget - b_rec, "moe_bf16", None)]
         if b_rec:
-            rec_prefer, n_rec = recorded_prefer_and_lanes()
+            rec_prefer, n_rec, rec_chosen = recorded_prefer_and_lanes()
             climb_cfg.insert(
                 0,
                 (Platform.make_n_lanes(n_rec), MOE_PHASES, rec_prefer, None,
-                 b_rec),
+                 b_rec, "recorded", rec_chosen),
             )
+    # distributed search fleet (docs/performance.md, "Distributed search"):
+    # --search-workers N / --measure-batch K route the SAME climb jobs
+    # through search/fleet.py — (1,1) is the serialized inline baseline
+    # (bit-identical to the legacy loop below), N>=2 spawns worker
+    # processes measuring through fused K-candidate rounds.  0/0 keeps the
+    # legacy loop byte-for-byte.
+    fleet_n = max(0, int(args.search_workers or 0))
+    fleet_k = max(0, int(args.measure_batch or 0))
+    fleet_engaged = fleet_n > 0 or fleet_k > 0
+    distributed_stats = None
+    if fleet_engaged and not climb_cfg and args.climb_budget > 0:
+        # --smoke builds no climb configs; synthesize a deterministic 2-job
+        # split of the climb budget — the job list depends only on the
+        # request (never on N or K), so the (1,1) serialized baseline and
+        # the fused fleet spend the same candidate budget
+        if args.workload == "halo":
+            from tenzing_tpu.models.halo_pipeline import HALO_PHASES as _FPH
+        elif args.workload == "moe":
+            from tenzing_tpu.models.moe_pipeline import PHASES as _FPH
+        else:
+            _FPH = ("",)
+        _half = max(1, args.climb_budget // 2)
+        climb_cfg = [
+            (plat, _FPH, generic_xla_prefer, None, _half,
+             "generic_xla", None),
+            (plat, _FPH, generic_xla_prefer, None, _half,
+             "generic_xla", None),
+        ]
     if climb_cfg and args.climb_budget > 0:
         from dataclasses import replace as _replace
 
@@ -1476,33 +1535,82 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
         # costs ~1.6s of measurement per neighbor on top of the ~3s compile.
         climb_opts = _replace(search_opts, n_iters=8,
                               target_secs=10 * search_opts.target_secs)
-        for ci, (cplat, cphases, cprefer, cpriority, cbudget) in enumerate(
-            climb_cfg
-        ):
+        if fleet_engaged:
+            from tenzing_tpu.search.fleet import (
+                FleetJob,
+                run_fleet,
+                run_serialized,
+            )
+
+            jobs = [
+                FleetJob(index=ci, budget=cbudget, seed=2 + ci,
+                         lanes=len(cplat.lanes), phases=tuple(cphases),
+                         prefer=pname, chosen=chosen)
+                for ci, (cplat, cphases, _cpf, _cpri, cbudget, pname,
+                         chosen) in enumerate(climb_cfg)
+            ]
+            n_w, k_fuse = max(1, fleet_n), max(1, fleet_k)
             t0 = time.time()
-            lres = hill_climb(
-                g, cplat, bench, cphases, prefer=cprefer, priority=cpriority,
-                opts=LocalOpts(budget=cbudget, bench_opts=climb_opts,
-                               seed=2 + ci, paired=True,
-                               prescreen=surrogate, checkpoint=ckpt,
-                               verify=verifier, prefetch=prefetcher),
-            )
-            lbest = lres.best()
+            if n_w == 1 and k_fuse == 1:
+                fres = run_serialized(
+                    g, jobs, bench, climb_opts, surrogate=surrogate,
+                    ckpt=ckpt, verifier=verifier, prefetcher=prefetcher)
+            else:
+                fres = run_fleet(
+                    g, args.to_json(), jobs, bench, climb_opts, n_w, k_fuse,
+                    prefetcher=prefetcher, verify=not args.no_verify)
+            distributed_stats = fres.stats
+            for jr in fres.jobs:
+                if jr.failed:
+                    sys.stderr.write(
+                        f"fleet job {jr.index}: FAILED ({jr.failed})\n")
+                    continue
+                for s in jr.sims:
+                    incumbent_labels[id(s)] = "climb"
+                res.sims = res.sims + jr.sims
+                if jr.final is not None:
+                    # the accepted chain tip always advances to the paired
+                    # screen, exactly like the legacy climb loop's
+                    incumbent_labels[id(jr.final)] = "climb-tip"
+                    incumbents.append(jr.final)
+                    res.sims = res.sims + [jr.final]
+            st = distributed_stats
             sys.stderr.write(
-                f"hill-climb[{ci}] ({len(cplat.lanes)} lanes): "
-                f"{len(lres.sims)} candidates, best "
-                f"pct50={lbest.result.pct50*1e6:.1f}us "
-                f"(wall {time.time()-t0:.0f}s)\n"
-            )
-            for s in lres.sims:
-                incumbent_labels[id(s)] = "climb"
-            res.sims = res.sims + lres.sims
-            if lres.final is not None:
-                # the accepted chain tip is the climb's official output: it
-                # always advances to the paired screen, like the incumbents
-                incumbent_labels[id(lres.final)] = "climb-tip"
-                incumbents.append(lres.final)
-                res.sims = res.sims + [lres.final]
+                f"fleet: {st['workers']}w K={st['measure_batch']}: "
+                f"{st['candidates']} candidates / {st['jobs']} jobs in "
+                f"{st['wall_s']}s ({st['rounds']} fused rounds, occupancy "
+                f"{st['batch_occupancy']}, {st['singles']} singles, "
+                f"{st['reclaimed_subtrees']} reclaimed, scaling "
+                f"{st['scaling_factor']}x, wall {time.time()-t0:.0f}s)\n")
+        else:
+            for ci, (cplat, cphases, cprefer, cpriority, cbudget, _pname,
+                     _chosen) in enumerate(climb_cfg):
+                t0 = time.time()
+                lres = hill_climb(
+                    g, cplat, bench, cphases, prefer=cprefer,
+                    priority=cpriority,
+                    opts=LocalOpts(budget=cbudget, bench_opts=climb_opts,
+                                   seed=2 + ci, paired=True,
+                                   prescreen=surrogate, checkpoint=ckpt,
+                                   verify=verifier, prefetch=prefetcher),
+                )
+                lbest = lres.best()
+                sys.stderr.write(
+                    f"hill-climb[{ci}] ({len(cplat.lanes)} lanes): "
+                    f"{len(lres.sims)} candidates, best "
+                    f"pct50={lbest.result.pct50*1e6:.1f}us "
+                    f"(wall {time.time()-t0:.0f}s)\n"
+                )
+                for s in lres.sims:
+                    incumbent_labels[id(s)] = "climb"
+                res.sims = res.sims + lres.sims
+                if lres.final is not None:
+                    # the accepted chain tip is the climb's official output:
+                    # it always advances to the paired screen, like the
+                    # incumbents
+                    incumbent_labels[id(lres.final)] = "climb-tip"
+                    incumbents.append(lres.final)
+                    res.sims = res.sims + [lres.final]
 
     # Candidate selection is DRIFT-IMMUNE (VERDICT r2 weak #1: raw search-
     # phase pct50s picked final candidates while naive drifted 254ms -> 129ms
@@ -2240,6 +2348,12 @@ def _run(args: DriverRequest, scope: _RunScope) -> DriverResult:
     # --synth-collectives
     if synth_block is not None:
         perf["synth"] = synth_block
+    # distributed-search provenance (ISSUE 20) — present iff the fleet ran
+    # (--search-workers / --measure-batch): wall-clock, candidates/sec,
+    # fused-round batch occupancy and the worker scaling factor, parsed by
+    # the CI distributed-search gate
+    if distributed_stats is not None:
+        perf["distributed"] = distributed_stats
     # regime metadata (VERDICT r4 item 6): cross-round vs_baseline
     # comparisons need the chip regime (naive_us), the measurement floors
     # that produced the verdict, and the warm-start provenance — without

@@ -300,7 +300,7 @@ class PrefetchingBenchmarker:
         return self.inner.benchmark(order, opts)
 
     def _batch_times(self, orders, opts: Optional[BenchOpts] = None,
-                     seed: int = 0, times_out=None):
+                     seed: int = 0, times_out=None, group_seeds=None):
         """Batch members parallel-compile across the pool before the inner
         batch warms them (today: a serial compile per member); a stored
         background failure for any member surfaces here, like the inline
@@ -313,8 +313,11 @@ class PrefetchingBenchmarker:
             if isinstance(o, Sequence):
                 self._join(o)
                 self._consume(o)
+        # forward group_seeds only when grouping is requested, so inner
+        # benchmarkers that predate fused rounds keep their old signature
+        kw = {} if group_seeds is None else {"group_seeds": group_seeds}
         return self.inner.benchmark_batch_times(
-            orders, opts, seed=seed, times_out=times_out)
+            orders, opts, seed=seed, times_out=times_out, **kw)
 
     def was_degraded(self, order) -> bool:
         fn = getattr(self.inner, "was_degraded", None)

@@ -203,15 +203,20 @@ class SearchCheckpoint:
         get_metrics().counter("fault.checkpoint.journaled").inc()
 
     def record_batch(self, ids: List[str], opts: Optional[BenchOpts],
-                     seed: int, times: List[List[float]]) -> None:
+                     seed: int, times: List[List[float]],
+                     groups=None) -> None:
         """Append one ``benchmark_batch_times`` result, keyed by the batch
         members' schedule ids (the pair digest) + the decorrelation seed +
         the fidelity key — the paired hill-climb's accept batches replay
-        from here on resume instead of re-running on device."""
-        line = json.dumps({
-            "batch": {"ids": list(ids), "seed": seed,
-                      "opts": _opts_key(opts), "times": times},
-        }, sort_keys=True)
+        from here on resume instead of re-running on device.  ``groups``
+        (when the round was fused from per-group seeds) rides in the key:
+        grouped and ungrouped rounds over the same ids are different
+        measurements."""
+        b = {"ids": list(ids), "seed": seed,
+             "opts": _opts_key(opts), "times": times}
+        if groups is not None:
+            b["groups"] = [[int(n), int(s)] for n, s in groups]
+        line = json.dumps({"batch": b}, sort_keys=True)
         self._check_fence()
         if self._journal_f is None:
             self._journal_f = open(self.journal_path, "a")
@@ -270,6 +275,9 @@ class SearchCheckpoint:
                     ok = b["opts"]
                     key = (tuple(b["ids"]), int(b["seed"]),
                            tuple(ok) if ok is not None else None)
+                    if b.get("groups") is not None:
+                        key = key + (tuple((int(n), int(s))
+                                           for n, s in b["groups"]),)
                     out[key] = [list(ts) for ts in b["times"]]
                 except Exception as e:
                     if log is not None:
@@ -389,11 +397,16 @@ class JournalingBenchmarker:
         return (tuple(ids), int(seed), tuple(ok) if ok is not None else None)
 
     def _batch_times(self, orders, opts: Optional[BenchOpts] = None,
-                     seed: int = 0, times_out=None):
+                     seed: int = 0, times_out=None, group_seeds=None):
         from tenzing_tpu.bench.benchmarker import schedule_id
 
         ids = [schedule_id(o) for o in orders]
         key = self._batch_key(ids, seed, opts)
+        if group_seeds is not None:
+            # grouped fusion changes each member's permutation stream, so a
+            # grouped round and an ungrouped round with the same (ids, seed)
+            # are different measurements — keep their journal keys apart
+            key = key + (tuple((int(n), int(s)) for n, s in group_seeds),)
         cached = self._batch_cache.get(key)
         if cached is not None:
             self.batch_hits += 1
@@ -405,9 +418,13 @@ class JournalingBenchmarker:
                     dst.extend(src)
                 return times_out
             return times
+        # only forward group_seeds when grouping is requested: inner
+        # benchmarkers that predate fused rounds keep their old signature
+        kw = {} if group_seeds is None else {"group_seeds": group_seeds}
         out = self.inner.benchmark_batch_times(orders, opts, seed=seed,
-                                               times_out=times_out)
+                                               times_out=times_out, **kw)
         recorded = [list(ts) for ts in out]
         self._batch_cache[key] = recorded
-        self.checkpoint.record_batch(ids, opts, seed, recorded)
+        self.checkpoint.record_batch(ids, opts, seed, recorded,
+                                     groups=group_seeds)
         return out

@@ -277,6 +277,7 @@ class ResilientBenchmarker:
         opts: Optional[BenchOpts] = None,
         seed: int = 0,
         times_out: Optional[List[List[float]]] = None,
+        group_seeds=None,
     ) -> List[List[float]]:
         """``benchmark_batch_times`` with the watchdog (scaled: a batch is
         ``len(orders)`` measurement series) and transient-class retries.
@@ -316,9 +317,13 @@ class ResilientBenchmarker:
                            ([[] for _ in orders]
                             if times_out is not None else None))
             try:
+                # inner benchmarkers that predate fused rounds keep their
+                # old signature: forward group_seeds only when grouping
+                gkw = {} if group_seeds is None else {
+                    "group_seeds": group_seeds}
                 out = self._call_with_timeout_scaled(
                     timeout, self.inner.benchmark_batch_times,
-                    orders, opts, seed=seed, times_out=inner_times)
+                    orders, opts, seed=seed, times_out=inner_times, **gkw)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:

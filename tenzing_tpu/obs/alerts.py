@@ -525,7 +525,9 @@ def evaluate(store_dirs: List[str], queue_dirs: List[str],
 
 def backlog_summary(store_dirs: List[str],
                     queue_dirs: List[str],
-                    max_daemons: Optional[int] = None) -> Dict[str, Any]:
+                    max_daemons: Optional[int] = None,
+                    quarantined_owners: Optional[set] = None
+                    ) -> Dict[str, Any]:
     """Arrival-vs-drain economics for the ``queue_backlog_burn`` rule
     and the follow view's ``burn`` line: arrival/s from reqlog position
     deltas across each live serve loop's snapshot ring (fallback: the
@@ -539,6 +541,12 @@ def backlog_summary(store_dirs: List[str],
     host.  Member slots quarantined by a live supervisor's crash-loop
     breakers are excluded from capacity (their stale status docs would
     otherwise inflate it) and reported as ``quarantined_daemons``.
+    ``quarantined_owners`` lets an in-process supervisor union its OWN
+    in-memory open/half-open breaker owners into that exclusion — its
+    breaker state is fresher than the published status snapshots (a
+    member can trip between status publishes, and the supervisor's own
+    doc write can lag), so the capacity estimate it scales on never
+    counts a member it has itself quarantined.
     Read-only and damage-tolerant: unreadable pieces contribute zero,
     never raise."""
     import math
@@ -600,7 +608,7 @@ def backlog_summary(store_dirs: List[str],
         # "stopped") status doc behind, which must not count as drain
         # capacity — or recommended_daemons under-recommends exactly
         # while the fleet is degraded
-        bad_members = set()
+        bad_members = set(str(o) for o in (quarantined_owners or ()))
         for st in docs:
             if st.get("kind") != "supervisor" or \
                     st.get("state") == "stopped":

@@ -100,6 +100,125 @@ class JaxControlPlane(ControlPlane):
         return float(np.max(xs))
 
 
+class FileControlPlane(ControlPlane):
+    """Same-host *process-fleet* control plane over a shared directory — the
+    coordination substrate of the distributed search fleet
+    (``search/fleet.py``): N worker processes plus one measurement owner,
+    none of which share a jax.distributed world.
+
+    Two primitives, both deliberately **non-blocking**:
+
+    * ``publish``/``gather`` — monotonic snapshot exchange.  Each rank
+      atomically replaces its own ``<tag>.r<rank>.json`` (generation-stamped);
+      ``gather`` reads whatever snapshots currently exist.  This is how
+      incumbents and visit statistics "allreduce" across the fleet: every
+      rank eventually sees every other rank's latest snapshot, and the
+      reduction (min cost, union of visited keys) happens in the reader.
+    * ``claim`` — an atomic winner-takes-all registry (``O_EXCL`` create,
+      the lease protocol's claim step without the lease): the first rank to
+      claim a key owns it, rivals get False.  The fleet claims canonical
+      schedule digests before measuring, which keeps subtrees *dynamically*
+      disjoint — a neighbor another worker already paid for is skipped.
+
+    Lockstep collectives (``barrier``/``bcast_json``/``allreduce_max``)
+    keep the single-host identity semantics inherited from
+    :class:`ControlPlane`: a fleet member can be SIGKILLed and its subtree
+    re-adopted mid-run (serve/lease.py reclaim), so any blocking rendezvous
+    would deadlock the survivors.  Device-side measurement coherence is the
+    *owner's* concern — workers never call into jax at all."""
+
+    def __init__(self, root: str, rank: int, size: int):
+        import os
+
+        self.root = root
+        self._rank = int(rank)
+        self._size = int(size)
+        self._gen = 0
+        os.makedirs(root, exist_ok=True)
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._size
+
+    # -- snapshot exchange ---------------------------------------------------
+    def publish(self, tag: str, obj: Any) -> None:
+        """Atomically replace this rank's snapshot under ``tag``."""
+        import os
+
+        from tenzing_tpu.utils.atomic import atomic_dump_json
+
+        self._gen += 1
+        atomic_dump_json(
+            os.path.join(self.root, f"{tag}.r{self._rank}.json"),
+            {"gen": self._gen, "rank": self._rank, "data": obj})
+
+    def gather(self, tag: str, include_self: bool = True) -> dict:
+        """``{rank: data}`` over every currently-published snapshot of
+        ``tag``.  Torn/missing files are skipped — a snapshot is an
+        optimization hint, never a correctness gate."""
+        import os
+
+        from tenzing_tpu.utils.atomic import read_json
+
+        out = {}
+        prefix, suffix = f"{tag}.r", ".json"
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith(suffix)):
+                continue
+            try:
+                rank = int(name[len(prefix):-len(suffix)])
+            except ValueError:
+                continue
+            if not include_self and rank == self._rank:
+                continue
+            try:
+                out[rank] = read_json(
+                    os.path.join(self.root, name))["data"]
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        return out
+
+    # -- winner-takes-all claims --------------------------------------------
+    def claim(self, tag: str, key: str) -> bool:
+        """True iff this rank is the FIRST in the fleet to claim ``key``
+        under ``tag`` (atomic ``O_EXCL`` create).  On registry I/O trouble
+        the claim is granted: a double measurement wastes budget, a
+        wrongly-skipped candidate loses coverage."""
+        import os
+
+        d = os.path.join(self.root, f"claims-{tag}")
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd = os.open(os.path.join(d, key),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            return True
+        try:
+            os.write(fd, str(self._rank).encode())
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+        return True
+
+    def claim_count(self, tag: str) -> int:
+        """How many keys have been claimed under ``tag`` fleet-wide."""
+        import os
+
+        try:
+            return len(os.listdir(os.path.join(self.root, f"claims-{tag}")))
+        except OSError:
+            return 0
+
+
 _DEFAULT: ControlPlane = ControlPlane()
 
 

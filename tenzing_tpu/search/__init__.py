@@ -1,0 +1,9 @@
+"""Distributed search: a fleet of solver processes over one device owner.
+
+``search.fleet`` scales the *search* itself — N worker processes run
+hill-climb/MCTS/DFS over disjoint subtrees and submit candidates to a
+single measurement owner that fuses K schedules per device round
+(``EmpiricalBenchmarker.benchmark_batch_times`` group seeds) — the
+ROADMAP's "distribute the search itself" scale-out, driven from
+``bench/driver.py`` behind ``--search-workers N --measure-batch K``.
+"""

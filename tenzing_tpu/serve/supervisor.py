@@ -715,8 +715,15 @@ class Supervisor:
         return poisoned > 0 and poisoned >= len(self.queue)
 
     def _scale_tick(self, now: float) -> None:
+        # pass the IN-MEMORY open/half-open breaker owners explicitly: the
+        # summary's own status-doc scan only sees breaker state as of the
+        # last publish, and a member that crash-looped since then must not
+        # count as drain capacity in the estimate this tick scales on
         bl = backlog_summary([self.store_base], [self.opts.queue_dir],
-                             max_daemons=self.max_daemons)
+                             max_daemons=self.max_daemons,
+                             quarantined_owners={
+                                 o for o, b in self.breakers.items()
+                                 if b.state in ("open", "half_open")})
         self._last_summary = bl
         desired = max(self.opts.min_daemons,
                       min(bl["recommended_daemons"], self.max_daemons))

@@ -76,6 +76,14 @@ class DfsOpts:
     # advisory; None (the default) is bit-identical to today.
     prefetch: Optional[object] = None
     prefetch_lookahead: int = 4
+    # disjoint fleet sharding ``(k, n)`` (search/fleet.py): after
+    # enumeration (+ prescreen), keep only terminals ``k % n, k % n + n,
+    # ...`` of the deterministic enumeration order — n workers agree on
+    # the partition from their rank alone, and the union of all n slices
+    # is exactly the un-sharded terminal set.  An empty slice degrades to
+    # the single terminal ``k % len`` so a worker always measures
+    # something.  None (the default) is bit-identical to pre-fleet.
+    subtree: Optional[tuple] = None
 
     def to_json(self) -> dict:
         """Provenance stamp of the options (reference dfs.cpp:11-14)."""
@@ -345,6 +353,10 @@ def explore(
                         f"{len(states)}/{len(states) + skipped} terminals",
                         kept=len(states), skipped=skipped,
                     )
+                if opts.subtree is not None and states:
+                    sk, sn = int(opts.subtree[0]), max(1, int(opts.subtree[1]))
+                    sliced = states[sk % sn::sn]
+                    states = sliced if sliced else [states[sk % len(states)]]
                 n = len(states)
             else:
                 states, n = [], 0

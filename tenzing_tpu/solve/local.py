@@ -206,6 +206,15 @@ class LocalOpts:
     # k's measurement.  Building the batch early is pure replay (no RNG):
     # None (the default) is bit-identical to prefetch-off.
     prefetch: Optional[object] = None
+    # cross-worker search exchange (search.fleet.SharedSearchState): a fleet
+    # of climbs over different seeds shares (a) a winner-takes-all claim
+    # registry of canonical schedule keys — ``claim(seq) -> False`` means
+    # another worker already paid for this neighbor, skip it budget-free
+    # like a local dedup hit — and (b) incumbent snapshots published on
+    # every accepted move (``note_incumbent(cost_s, seq)``), the fleet's
+    # "allreduce incumbents" half.  None = solo climb, bit-identical to the
+    # pre-fleet behavior.
+    shared: Optional[object] = None
 
 
 @dataclass
@@ -338,6 +347,8 @@ def hill_climb(
     seen = {canonical_key(seq)}
     spent = 1 if charge else 0
     accepted = 0
+    if opts.shared is not None:
+        opts.shared.note_incumbent(cur.pct50, seq)
 
     def save_cursor():
         if opts.checkpoint is not None:
@@ -401,6 +412,12 @@ def hill_climb(
                     # WITHOUT charging the budget
                     continue
                 seen.add(key)
+                if opts.shared is not None and not opts.shared.claim(cand_seq):
+                    # another fleet worker already claimed this exact
+                    # canonical schedule — the subtrees stay *dynamically*
+                    # disjoint, and the skip is budget-free like a local
+                    # dedup hit
+                    continue
                 if opts.prescreen is not None:
                     mu_c, s_c = opts.prescreen.predict(cand_seq)
                     mu_i, s_i = opts.prescreen.predict(seq)
@@ -427,6 +444,8 @@ def hill_climb(
                     cur, seq, decisions = res, cand_seq, cand_dec
                     improved = True
                     accepted += 1
+                    if opts.shared is not None:
+                        opts.shared.note_incumbent(cur.pct50, seq)
                     save_cursor()  # accepted moves only: the cursor is
                     # consistency metadata (resume replays the journal), so
                     # a per-neighbor atomic rewrite would just double the

@@ -14,7 +14,7 @@ from __future__ import annotations
 import random as _random
 import sys
 from dataclasses import dataclass, field
-from typing import List, Optional, Type
+from typing import List, Optional, Tuple, Type
 
 from tenzing_tpu.bench.benchmarker import (
     BenchOpts,
@@ -91,6 +91,14 @@ class MctsOpts:
     prefetch: Optional[object] = None
     # how many speculative child completions to hint per iteration
     prefetch_rollouts: int = 2
+    # disjoint fleet sharding ``(k, n)`` (search/fleet.py): restrict the
+    # search to the k-th of n slices of the root's top-level children —
+    # the enumeration is deterministic (Node.ensure_children sorts by
+    # decision key), so n workers agree on the partition from their rank
+    # alone, with no exchange.  An empty slice falls back to the single
+    # child ``k % len`` so every worker always has a subtree.  None (the
+    # default) searches the whole tree — bit-identical to pre-fleet.
+    subtree: Optional[Tuple[int, int]] = None
 
     def to_json(self) -> dict:
         return {
@@ -229,6 +237,24 @@ def _speculative_completions(node: Node, platform, prng, k: int,
     return hints
 
 
+def prune_to_subtree(root: Node, platform, subtree: Tuple[int, int]) -> None:
+    """Restrict ``root`` to the k-th of n rank-agreed top-level slices
+    (``MctsOpts.subtree``): expand the root's children — a deterministic
+    enumeration, identical in every process — and keep indices
+    ``i % n == k % n``.  An empty slice degrades to the single child
+    ``k % len(children)`` so a worker never ends up with nothing to
+    search.  The kept children and everything below them are untouched:
+    UCT statistics, seeds landing inside the slice, and the stop protocol
+    all behave exactly as in a whole-tree search."""
+    k, n = int(subtree[0]), max(1, int(subtree[1]))
+    root.ensure_children(platform)
+    kids = root.children
+    if not kids:
+        return
+    keep = [c for i, c in enumerate(kids) if i % n == k % n]
+    root.children = keep if keep else [kids[k % len(kids)]]
+
+
 def explore(
     graph: Graph,
     platform,
@@ -291,6 +317,8 @@ def explore(
         root = Node(State(graph), strategy) if cp.rank() == 0 else None
         if root is not None:
             ctx.root = root
+            if opts.subtree is not None:
+                prune_to_subtree(root, platform, opts.subtree)
         seed_iter = iter(seeds if seeds is not None else ())
         if opts.prefetch is not None and cp.rank() == 0 and seeds:
             # the seed queue's terminal schedules are known now; compile

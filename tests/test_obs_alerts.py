@@ -568,3 +568,20 @@ def test_backlog_summary_excludes_quarantined_members(tree):
     assert bl["daemons"] == 1  # w2 only
     assert bl["quarantined_daemons"] == 1
     assert bl["drain_per_s"] == 1.0  # w1's stale doc contributes nothing
+
+
+def test_backlog_summary_in_memory_quarantined_owners(tree):
+    """The supervisor's IN-MEMORY breaker state is fresher than its
+    published status doc: ``quarantined_owners`` must exclude a member the
+    docs still show as healthy (no supervisor doc at all here — the
+    pre-first-publish window) from drain capacity."""
+    store, queue = tree
+    _daemon_status(queue, "w1", [{"outcome": "completed", "wall_s": 1.0}])
+    _daemon_status(queue, "w2", [{"outcome": "completed", "wall_s": 1.0}])
+    bl = backlog_summary([store], [queue], max_daemons=0)
+    assert bl["daemons"] == 2  # no breaker evidence on disk
+    bl = backlog_summary([store], [queue], max_daemons=0,
+                         quarantined_owners={"w1"})
+    assert bl["daemons"] == 1
+    assert bl["quarantined_daemons"] == 1
+    assert bl["drain_per_s"] == 1.0

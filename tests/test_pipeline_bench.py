@@ -263,6 +263,115 @@ def test_background_failure_surfaces_classified_and_quarantines_once(
     assert not _prefetch_threads()
 
 
+# -- fused batched rounds (the search fleet's measurement owner) ------------
+
+
+class BatchDb:
+    """CsvBenchmarker plus the fused-round batch protocol
+    (``benchmark_batch_times``) — the shape the fleet's measurement owner
+    drives (search/fleet.py): each member answered from the recorded corpus
+    in one call, per-group seeds recorded for the passthrough assertion."""
+
+    def __init__(self, db):
+        self.db = db
+        self.batch_calls = 0
+        self.last_group_seeds = None
+
+    def benchmark(self, order, opts=None):
+        return self.db.benchmark(order, opts)
+
+    def benchmark_batch_times(self, orders, opts=None, seed=0,
+                              times_out=None, group_seeds=None):
+        self.batch_calls += 1
+        self.last_group_seeds = group_seeds
+        out = []
+        for o in orders:
+            r = self.db.benchmark(o, opts)
+            ts = list(r.times) if r.times else [r.pct50] * 3
+            if times_out is not None:
+                times_out[len(out)].extend(ts)
+            out.append(ts)
+        return out
+
+
+def test_batched_round_full_queue_drops_hints_without_blocking(
+        corpus, registry):
+    """A fused measurement round over a saturated prefetch pipeline must
+    DROP its members' hints and still run: the members are simply not
+    prefetched (the inner batch warms them itself), the round never blocks
+    behind speculative work hinted earlier, and the shed hints land on the
+    ``dropped`` tally (re-hintable later)."""
+    rows, terminals = corpus
+    assert len(terminals) >= 6
+    gate = threading.Event()
+
+    class GatedExecutor(FakeExecutor):
+        def precompile(self, order):
+            gate.wait(30.0)
+            return super().precompile(order)
+
+    ex = GatedExecutor()
+    inner = BatchDb(mk_db(rows))
+    p = PrefetchingBenchmarker(inner, executor=ex, workers=1, depth=2)
+    try:
+        # saturate: 1 compile parked on the gate + 1 queued = depth
+        p.prefetch(terminals[:4])
+        assert p.issued == 2 and p.dropped == 2
+        members = terminals[4:6]
+        t0 = time.time()
+        times = p.benchmark_batch_times(
+            members, None, seed=3, group_seeds=[(1, 5), (1, 7)])
+        wall = time.time() - t0
+        # the round completed inline while the pool stayed parked
+        assert wall < 5.0 and not gate.is_set()
+        assert inner.batch_calls == 1
+        assert inner.last_group_seeds == [(1, 5), (1, 7)]
+        db = mk_db(rows)
+        assert times == [[db.benchmark(o, None).pct50] * 3 for o in members]
+        # both members' hints were shed, never queued behind the backlog
+        assert p.dropped == 4
+        assert registry.counter("pipeline.prefetch.dropped").value == 4
+    finally:
+        gate.set()
+        p.close()
+    assert not _prefetch_threads()
+
+
+def test_batched_round_surfaces_stored_failure_exactly_once(
+        corpus, registry):
+    """A background compile failure stored for a batch member surfaces on
+    the foreground join of the fused round — once.  The raise consumes the
+    stored failure (the resilient layer's retry contract), so the next
+    round over the same members reaches the inner batch instead of
+    re-raising a stale exception."""
+    rows, terminals = corpus
+    bad, good = terminals[0], terminals[1]
+    bad_sid = schedule_id(bad)
+    ex = FakeExecutor(fail=lambda o: RuntimeError(
+        "failed to compile: injected") if schedule_id(o) == bad_sid else None)
+    inner = BatchDb(mk_db(rows))
+    p = PrefetchingBenchmarker(inner, executor=ex, workers=1)
+    try:
+        assert p.prefetch([bad]) == 1
+        deadline = time.time() + 10.0
+        while p.failed < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert p.failed == 1
+        with pytest.raises(RuntimeError, match="injected"):
+            p.benchmark_batch_times([bad, good], None, seed=1)
+        # surfaced before the inner batch ran, and exactly once
+        assert p.surfaced == 1 and inner.batch_calls == 0
+        assert registry.counter("pipeline.prefetch.surfaced").value == 1
+        times = p.benchmark_batch_times([bad, good], None, seed=1)
+        assert len(times) == 2 and inner.batch_calls == 1
+        assert p.surfaced == 1  # consumed: no stale re-raise
+        assert registry.counter("pipeline.prefetch.surfaced").value == 1
+        assert p.hits == 1  # the healthy member's hint landed meanwhile
+    finally:
+        p.close()
+    assert not _prefetch_threads()
+
+
 def test_transient_background_failure_retries_through_to_real_attempt(
         corpus, registry):
     """A surfaced TRANSIENT background failure is consumed by the raise:

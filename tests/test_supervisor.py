@@ -219,7 +219,7 @@ def test_scaling_hysteresis_cooldown_and_poison_suppression(
     rec = {"n": 1}
     monkeypatch.setattr(
         "tenzing_tpu.serve.supervisor.backlog_summary",
-        lambda stores, queues, max_daemons=None: {
+        lambda stores, queues, max_daemons=None, quarantined_owners=None: {
             "recommended_daemons": rec["n"]})
     t = 1000.0
     sup._scale_up(t)                                 # the min fill
@@ -278,7 +278,7 @@ def test_recommendation_is_clamped_by_max_daemons(tmp_path, monkeypatch):
                scale_hold_ticks=1, cooldown_secs=0.0)
     monkeypatch.setattr(
         "tenzing_tpu.serve.supervisor.backlog_summary",
-        lambda stores, queues, max_daemons=None: {
+        lambda stores, queues, max_daemons=None, quarantined_owners=None: {
             "recommended_daemons": min(50, max_daemons or 50)})
     t = 1000.0
     sup._scale_up(t)
